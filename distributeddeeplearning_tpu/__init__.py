@@ -1,0 +1,23 @@
+"""TPU-native distributed deep-learning framework.
+
+Capability rebuild of the reference ``bbondd/DistributedDeepLearning`` — a
+CUDA/NCCL data-parallel trainer (attested by ``BASELINE.json:5``; the
+reference mount was empty, see ``SURVEY.md`` §0) — designed TPU-first:
+
+- compute is XLA-compiled (``jax.jit``) with Pallas kernels for hot ops,
+  replacing the reference's hand-written CUDA forward/backward/optimizer
+  kernels;
+- gradient sync is ``psum``/``psum_scatter`` over named mesh axes inside the
+  compiled step, replacing NCCL allreduce;
+- parameters and optimizer state are HBM-resident, mesh-sharded arrays
+  (``jax.sharding.NamedSharding``), replacing per-rank replicas;
+- data arrives through a per-host pipeline with device prefetch, replacing
+  the host-side DataLoader + H2D copy engine.
+
+Layering (each module depends only on earlier ones):
+``mesh`` -> ``comms``/``sharding`` -> ``parallel``/``ops`` ->
+``train``/``data``/``checkpoint`` -> ``models`` -> ``config``/``metrics`` ->
+``cli``.
+"""
+
+__version__ = "0.1.0"

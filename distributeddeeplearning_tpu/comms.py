@@ -1,0 +1,92 @@
+"""Collective-communication layer — the TPU equivalent of the reference's NCCL.
+
+The reference (``BASELINE.json:5``) uses NCCL allreduce for gradient sync and
+NCCL broadcast for parameter init, managed as explicit host-side library calls
+on CUDA streams. On TPU there is no user-space transport: these wrappers are
+thin conventions over ``jax.lax`` collectives that only exist *inside* a
+compiled program (under ``jax.shard_map`` / ``jit`` with a mesh), where XLA
+schedules them over ICI/DCN and overlaps them with compute via its
+latency-hiding scheduler.
+
+Mapping (reference -> here):
+- ncclAllReduce(grads)        -> :func:`psum` / :func:`pmean` over ``dp``-like axes
+- ncclReduceScatter + ZeRO    -> :func:`reduce_scatter`
+- ncclAllGather               -> :func:`all_gather`
+- ncclBroadcast(params, root) -> :func:`broadcast` (masked psum)
+- ncclSend/Recv ring          -> :func:`ring_shift` (ppermute)
+- MoE / Ulysses all-to-all    -> :func:`all_to_all`
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+AxisName = str | tuple[str, ...]
+
+
+def psum(x, axis: AxisName):
+    """All-reduce sum over ``axis`` (gradient sync; NCCL allreduce analogue)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: AxisName):
+    """All-reduce mean over ``axis`` (loss/metric aggregation)."""
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str, *, gather_axis: int = 0, tiled: bool = True):
+    """Gather shards along ``gather_axis`` from every member of ``axis``.
+
+    ``tiled=True`` concatenates into the existing dimension (NCCL allgather
+    semantics); ``tiled=False`` stacks a new leading device dimension.
+    """
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0):
+    """Reduce-sum over ``axis`` then scatter shards along ``scatter_axis``.
+
+    The ZeRO-1 gradient path: each member keeps 1/N of the summed gradient.
+    """
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
+    """Transpose shards between a tensor dimension and the mesh ``axis``
+    (Ulysses sequence<->head reshard; MoE token dispatch)."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def axis_index(axis: str):
+    """This member's coordinate along ``axis``."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    """Static size of a mesh axis, usable inside shard_map-traced code."""
+    return lax.axis_size(axis)
+
+
+def ring_shift(x, axis: str, *, shift: int = 1):
+    """Rotate ``x`` around the ``axis`` ring: member i receives the value held
+    by member ``i - shift`` (mod N). The building block of ring attention and
+    pipeline communication; on TPU each hop is one ICI-neighbor ``ppermute``.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def broadcast(x, axis: str, *, src: int = 0):
+    """Broadcast the value held by ``src`` to all members of ``axis``.
+
+    The init-time parameter broadcast (reference: NCCL broadcast from rank 0;
+    ``BASELINE.json:5`` "Parameter broadcast at init"). Implemented as a
+    masked psum, which XLA lowers to an efficient collective.
+    """
+    idx = lax.axis_index(axis)
+    masked = jax.tree.map(lambda a: jax.numpy.where(idx == src, a, 0), x)
+    return lax.psum(masked, axis)

@@ -1,0 +1,150 @@
+"""Device-mesh construction — the L0 runtime floor.
+
+TPU-native replacement for the reference's NCCL process-group / communicator
+management (``BASELINE.json:5``: "CUDA/NCCL distributed trainer"). Instead of
+per-strategy NCCL communicators, there is ONE ``jax.sharding.Mesh`` with named
+axes; every parallelism strategy is expressed as a ``PartitionSpec`` over these
+axes, and XLA lowers the resulting collectives onto ICI (intra-slice torus) or
+DCN (cross-slice) depending on axis placement.
+
+Axis conventions (outermost/slowest first — DCN-crossing axes must come first
+so that their collectives ride DCN while everything else stays on ICI):
+
+- ``dp``    pure data parallelism (gradient psum; params replicated)
+- ``fsdp``  data parallelism with parameter/optimizer sharding (ZeRO-ish)
+- ``pp``    pipeline stages
+- ``tp``    tensor parallelism (Megatron-style column/row sharding)
+- ``cp``    context/sequence parallelism (ring attention, Ulysses)
+- ``ep``    expert parallelism (MoE)
+
+A batch is sharded over ``('dp', 'fsdp')`` jointly; all other axes partition
+model state or sequence dimensions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import warnings
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis order. DCN-crossing replicas (if any) split the leading dp
+# axis, so dp stays outermost.
+MESH_AXES: tuple[str, ...] = ("dp", "fsdp", "pp", "tp", "cp", "ep")
+
+# Axes over which the global batch is sharded.
+BATCH_AXES: tuple[str, ...] = ("dp", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each named mesh axis.
+
+    Exactly one axis may be ``-1`` meaning "absorb all remaining devices".
+    ``dcn_dp > 1`` declares that the leading ``dp`` axis spans that many
+    TPU slices over DCN (hybrid mesh); within this single-host environment it
+    simply changes device-order construction.
+    """
+
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    tp: int = 1
+    cp: int = 1
+    ep: int = 1
+    dcn_dp: int = 1
+
+    def axis_sizes(self, n_devices: int) -> dict[str, int]:
+        sizes = {a: getattr(self, a) for a in MESH_AXES}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+        for a, s in sizes.items():
+            if s < 1 and s != -1:
+                raise ValueError(f"axis {a!r} has invalid size {s}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {math.prod(sizes.values())} devices, "
+                f"have {n_devices}"
+            )
+        for a, s in sizes.items():
+            if s < 1:
+                raise ValueError(f"axis {a!r} resolved to invalid size {s}")
+        return sizes
+
+
+def build_mesh(
+    config: MeshConfig | None = None, devices: list | None = None
+) -> Mesh:
+    """Build the global mesh.
+
+    Uses ``mesh_utils.create_device_mesh`` so that, on a real TPU slice, mesh
+    axes are laid out contiguously on the ICI torus (the TPU analogue of NCCL
+    ring/tree topology autodetection). For ``dcn_dp > 1`` a hybrid mesh is
+    built with the DCN factor outermost. Falls back to a plain reshape where
+    topology info is unavailable (CPU simulation, single device).
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = config.axis_sizes(n)
+    shape = tuple(sizes[a] for a in MESH_AXES)
+
+    if config.dcn_dp > 1:
+        if sizes["dp"] % config.dcn_dp:
+            raise ValueError(
+                f"dp={sizes['dp']} not divisible by dcn_dp={config.dcn_dp}"
+            )
+        ici_shape = (sizes["dp"] // config.dcn_dp,) + shape[1:]
+        dcn_shape = (config.dcn_dp,) + (1,) * (len(MESH_AXES) - 1)
+        try:
+            arr = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices
+            )
+        except Exception as e:  # no slice metadata (CPU sim) -> order-preserving
+            _warn_topology_fallback(e)
+            arr = np.asarray(devices).reshape(shape)
+    else:
+        try:
+            arr = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True
+            )
+        except Exception as e:  # CPU sim / unusual topology
+            _warn_topology_fallback(e)
+            arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def _warn_topology_fallback(e: Exception) -> None:
+    # On real multi-chip hardware a fallback here silently loses ICI/DCN
+    # contiguity (collectives may cross the wrong links) — make it loud.
+    # On CPU sim / single device the fallback is expected and harmless.
+    if any(d.platform != "cpu" for d in jax.devices()) and len(jax.devices()) > 1:
+        warnings.warn(
+            f"topology-aware mesh construction failed ({type(e).__name__}: {e}); "
+            "falling back to enumeration-order reshape — collective performance "
+            "may be degraded",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """All-axes-size-1 mesh on one device (the unsharded baseline for parity
+    tests and the single-chip path)."""
+    if device is None:
+        device = jax.devices()[0]
+    arr = np.asarray([device]).reshape((1,) * len(MESH_AXES))
+    return Mesh(arr, MESH_AXES)

@@ -1,0 +1,95 @@
+"""Logical-axis partitioning rules — how every array picks up its sharding.
+
+The reference binds each parallelism strategy to hand-managed NCCL groups and
+per-rank tensor slices; here a *logical axis name* is attached to each array
+dimension at model-definition time (via ``flax.linen.with_logical_partitioning``
+/ ``with_logical_constraint``) and ONE rules table maps logical names to mesh
+axes. Changing parallelism strategy = changing the rules/mesh, never the model.
+
+Logical axis vocabulary used across the model zoo:
+
+==========  =====================================================
+``batch``    global batch dimension (activations, inputs)
+``seq``      sequence/token dimension (activations)
+``embed``    model/hidden dimension
+``heads``    attention heads
+``kv``       per-head dimension
+``mlp``      MLP hidden (intermediate) dimension
+``vocab``    vocabulary / classifier output dimension
+``expert``   MoE expert dimension
+``stage``    pipeline-stage-stacked parameters
+``conv_*``   conv kernel spatial/channel dims (never sharded)
+==========  =====================================================
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .mesh import BATCH_AXES
+
+# Rules: logical axis -> mesh axis (or tuple of axes, or None = replicated).
+# Megatron-style TP shards heads/mlp/vocab over 'tp'; FSDP shards the embed
+# dimension of parameters over 'fsdp'; batch is sharded jointly over dp+fsdp;
+# seq over 'cp' (ring/Ulysses context parallelism); experts over 'ep'.
+DEFAULT_LOGICAL_RULES: tuple[tuple[str, str | tuple[str, ...] | None], ...] = (
+    ("batch", BATCH_AXES),
+    ("seq", "cp"),
+    ("embed", "fsdp"),
+    ("heads", "tp"),
+    ("kv", None),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("expert", "ep"),
+    ("stage", "pp"),
+    ("conv_hw", None),
+    ("conv_in", None),
+    ("norm", None),
+)
+
+
+def make_rules(
+    **overrides: str | tuple[str, ...] | None,
+) -> tuple[tuple[str, str | tuple[str, ...] | None], ...]:
+    """DEFAULT_LOGICAL_RULES with per-logical-axis overrides.
+
+    e.g. ``make_rules(embed=None)`` disables FSDP parameter sharding.
+    """
+    table = dict(DEFAULT_LOGICAL_RULES)
+    for k, v in overrides.items():
+        table[k] = v
+    return tuple(table.items())
+
+
+def logical_to_mesh_sharding(tree, mesh: Mesh, rules=DEFAULT_LOGICAL_RULES):
+    """Map a pytree of logical-axis-annotated metadata (as produced by
+    ``nn.get_partition_spec`` on a flax variable tree) to ``NamedSharding``s.
+    """
+    return nn.logical_to_mesh_sharding(tree, mesh, rules)
+
+
+def named_sharding(mesh: Mesh, *axes) -> NamedSharding:
+    """``NamedSharding(mesh, P(*axes))`` shorthand."""
+    return NamedSharding(mesh, P(*axes))
+
+
+def batch_spec() -> P:
+    """PartitionSpec for a [batch, ...] array: batch over dp+fsdp."""
+    return P(BATCH_AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def constrain(x, *logical_axes, rules=DEFAULT_LOGICAL_RULES):
+    """Constrain an activation's sharding by logical axis names (no-op outside
+    a mesh context). Used inside model code between blocks."""
+    return nn.with_logical_constraint(x, P(*logical_axes), rules=rules)

@@ -1,0 +1,4 @@
+"""Shared small utilities (pytree math, RNG discipline)."""
+
+from .pytree import global_norm, tree_bytes, tree_cast, tree_size  # noqa: F401
+from .rng import fold_in_step  # noqa: F401

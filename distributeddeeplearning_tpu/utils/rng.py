@@ -1,0 +1,15 @@
+"""RNG discipline: one root key, deterministic folds.
+
+Determinism across restarts (checkpoint/resume replays the same dropout
+pattern for a given step) comes from deriving every per-step key by folding
+the step counter into a stored root key, never by splitting statefully.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def fold_in_step(rng: jax.Array, step) -> jax.Array:
+    """Per-step key: fold the (traced or concrete) step into the root key."""
+    return jax.random.fold_in(rng, step)

@@ -1,0 +1,54 @@
+"""Test harness: force an 8-device CPU simulation.
+
+This container's ``sitecustomize`` registers the ``axon`` TPU backend in every
+Python process when ``PALLAS_AXON_POOL_IPS`` is set, and the environment pins
+``JAX_PLATFORMS=axon`` (1 real chip). Multi-device parity tests need 8 fake
+devices instead, so BEFORE any backend is initialized we flip the jax config
+to CPU with 8 virtual devices (verified to work even though sitecustomize has
+already imported jax). Real-TPU smoke tests opt back in via the
+``@pytest.mark.tpu`` marker and run in a subprocess (see helpers.run_on_tpu).
+"""
+
+import os
+
+# For any subprocesses tests spawn.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+from distributeddeeplearning_tpu.mesh import (  # noqa: E402
+    MeshConfig,
+    build_mesh,
+    single_device_mesh,
+)
+
+
+def make_mesh(**axis_sizes):
+    """Mesh over the 8 simulated CPU devices; unspecified axes default to 1,
+    except dp which absorbs the remainder unless given."""
+    cfg = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig(dp=8)
+    return build_mesh(cfg)
+
+
+@pytest.fixture
+def mesh8():
+    """dp=8 mesh (pure data parallel)."""
+    return make_mesh(dp=8)
+
+
+@pytest.fixture
+def mesh1():
+    """Single-device all-axes-1 mesh (the parity baseline)."""
+    return single_device_mesh()
+
+
+@pytest.fixture
+def mesh_factory():
+    return make_mesh

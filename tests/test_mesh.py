@@ -1,0 +1,65 @@
+"""M0: mesh construction invariants."""
+
+import jax
+import pytest
+
+from distributeddeeplearning_tpu.mesh import (
+    BATCH_AXES,
+    MESH_AXES,
+    MeshConfig,
+    build_mesh,
+    single_device_mesh,
+)
+
+
+def test_default_config_absorbs_all_devices():
+    mesh = build_mesh()
+    shape = dict(mesh.shape)
+    assert shape["dp"] == 8
+    assert all(shape[a] == 1 for a in MESH_AXES if a != "dp")
+
+
+def test_axis_order_is_canonical():
+    mesh = build_mesh(MeshConfig(dp=2, tp=2, fsdp=2))
+    assert mesh.axis_names == MESH_AXES
+    assert dict(mesh.shape) == {
+        "dp": 2, "fsdp": 2, "pp": 1, "tp": 2, "cp": 1, "ep": 1,
+    }
+
+
+def test_wildcard_inference():
+    sizes = MeshConfig(dp=-1, tp=4).axis_sizes(8)
+    assert sizes["dp"] == 2 and sizes["tp"] == 4
+
+
+def test_two_wildcards_rejected():
+    with pytest.raises(ValueError, match="at most one"):
+        MeshConfig(dp=-1, fsdp=-1).axis_sizes(8)
+
+
+def test_nondivisible_rejected():
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3).axis_sizes(8)
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, tp=3).axis_sizes(8)
+
+
+def test_zero_axis_size_rejected():
+    with pytest.raises(ValueError, match="invalid size"):
+        MeshConfig(dp=-1, tp=0).axis_sizes(8)
+
+
+def test_hybrid_dcn_mesh_shape():
+    # dcn_dp=2 simulates 2 slices over DCN; on CPU sim we only check shape.
+    mesh = build_mesh(MeshConfig(dp=4, tp=2, dcn_dp=2))
+    assert mesh.shape["dp"] == 4
+
+
+def test_single_device_mesh():
+    mesh = single_device_mesh()
+    assert mesh.devices.size == 1
+    assert mesh.axis_names == MESH_AXES
+
+
+def test_batch_axes_subset_of_mesh_axes():
+    assert set(BATCH_AXES) <= set(MESH_AXES)
