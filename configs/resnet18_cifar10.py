@@ -1,0 +1,30 @@
+"""Workload 1 (BASELINE.json:7): ResNet-18 on CIFAR-10, plain SGD.
+
+The reference runs this single-process on CPU; here it is the single-chip
+(or dp=N) baseline config with synthetic CIFAR-shaped data.
+"""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(name="resnet18", kwargs={"num_classes": 10}),
+        data=DataConfig(
+            kind="synthetic_image",
+            batch_size=128,
+            image_size=32,
+            num_classes=10,
+        ),
+        optim=OptimConfig(name="sgd", lr=0.1, momentum=0.9, schedule="cosine",
+                          warmup_steps=5),
+        train=TrainConfig(steps=200, log_every=10, task="classification"),
+        mesh=MeshConfig(dp=-1),
+    )

@@ -1,0 +1,97 @@
+"""CLI entry points: ``python -m distributeddeeplearning_tpu.cli <cmd>``.
+
+Subcommands mirror the reference's per-config training entrypoints
+(``BASELINE.json:5`` "the existing training entrypoints"): one config file per
+workload, plus ``benchmark`` for the north-star throughput measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import jax
+
+from . import data as data_lib
+from . import models
+from .config import Config, apply_overrides, load_config
+from .mesh import build_mesh
+from .train import Trainer, fit, get_task, make_optimizer
+from .utils.pytree import tree_size
+
+
+def build_all(cfg: Config):
+    """Construct (mesh, model, trainer, batches) from a config."""
+    mesh = build_mesh(cfg.mesh)
+    model = models.get_model(cfg.model.name, **cfg.model.kwargs)
+    tx = make_optimizer(
+        cfg.optim.name,
+        cfg.optim.lr,
+        momentum=cfg.optim.momentum,
+        b1=cfg.optim.b1,
+        b2=cfg.optim.b2,
+        weight_decay=cfg.optim.weight_decay,
+        warmup_steps=cfg.optim.warmup_steps,
+        schedule=cfg.optim.schedule,
+        total_steps=cfg.train.steps,
+        grad_clip=cfg.optim.grad_clip,
+    )
+    trainer = Trainer(
+        model,
+        tx,
+        get_task(cfg.train.task),
+        mesh,
+        grad_accum=cfg.train.grad_accum,
+    )
+    dataset = data_lib.make_dataset(cfg.data.kind, **cfg.data.dataset_kwargs())
+    batches = data_lib.prefetch(data_lib.sharded_batches(dataset, mesh))
+    return mesh, model, trainer, dataset, batches
+
+
+def cmd_train(cfg: Config) -> int:
+    mesh, _, trainer, dataset, batches = build_all(cfg)
+    print(f"devices: {jax.device_count()}  mesh: {dict(mesh.shape)}")
+    state = trainer.init(cfg.train.seed, dataset.batch(0))
+    print(f"model: {cfg.model.name}  params: {tree_size(state.params):,}")
+    fit(
+        trainer,
+        state,
+        batches,
+        steps=cfg.train.steps,
+        log_every=cfg.train.log_every,
+        log_fn=lambda m: print(json.dumps(m)),
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="distributeddeeplearning_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name in ("train", "benchmark"):
+        p = sub.add_parser(name)
+        p.add_argument("--config", required=True, help="path to a config .py")
+        p.add_argument(
+            "--override",
+            action="append",
+            default=[],
+            metavar="a.b=v",
+            help="dotted config override (repeatable)",
+        )
+    args = parser.parse_args(argv)
+    cfg = apply_overrides(load_config(args.config), args.override)
+    if args.cmd == "train":
+        return cmd_train(cfg)
+    if args.cmd == "benchmark":
+        try:
+            from .benchmark import run_benchmark
+        except ImportError:
+            print("benchmark subcommand not implemented yet", file=sys.stderr)
+            return 2
+        print(json.dumps(run_benchmark(cfg)))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Config system: frozen dataclasses + dotted CLI overrides.
+
+One canonical config module per reference workload lives in ``configs/``
+(``BASELINE.json:6-12``); each exposes ``get_config() -> Config``. Overrides
+use ``--override section.field=value`` with python-literal values, e.g.
+``--override train.steps=500 --override mesh.dp=4``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib.util
+import json
+from typing import Any
+
+from .mesh import MeshConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "resnet18"
+    kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    kind: str = "synthetic_image"
+    batch_size: int = 64
+    image_size: int = 32
+    channels: int = 3
+    num_classes: int = 10
+    seq_len: int = 128
+    vocab_size: int = 1024
+    n_distinct: int = 8
+    seed: int = 0
+
+    def dataset_kwargs(self) -> dict[str, Any]:
+        common = {"batch_size": self.batch_size, "seed": self.seed,
+                  "n_distinct": self.n_distinct}
+        if self.kind == "synthetic_image":
+            return common | {
+                "image_size": self.image_size,
+                "channels": self.channels,
+                "num_classes": self.num_classes,
+            }
+        if self.kind == "synthetic_tokens":
+            return common | {"seq_len": self.seq_len, "vocab_size": self.vocab_size}
+        return common
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    name: str = "sgd"
+    lr: float = 0.1
+    momentum: float = 0.9
+    b1: float = 0.9
+    b2: float = 0.999
+    weight_decay: float = 0.0
+    warmup_steps: int = 0
+    schedule: str = "constant"
+    grad_clip: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    steps: int = 100
+    log_every: int = 10
+    seed: int = 0
+    task: str = "classification"
+    grad_accum: int = 1
+    remat: str = "none"  # none | full | dots (M2)
+    zero1: bool = False  # ZeRO-1 optimizer-state sharding (M2)
+    checkpoint_dir: str = ""
+    save_every: int = 0
+    eval_every: int = 0
+    profile_steps: str = ""  # "a:b" -> jax.profiler trace window
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, default=str)
+
+
+def load_config(path: str) -> Config:
+    """Import a config module by file path and call its ``get_config()``."""
+    spec = importlib.util.spec_from_file_location("_ddl_config", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    cfg = mod.get_config()
+    if not isinstance(cfg, Config):
+        raise TypeError(f"{path}: get_config() returned {type(cfg)}, not Config")
+    return cfg
+
+
+def apply_overrides(cfg: Config, overrides: list[str]) -> Config:
+    """Apply ``section.field=value`` overrides (values are python literals;
+    bare words fall back to strings)."""
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override {item!r} is not of the form a.b=value")
+        dotted, raw = item.split("=", 1)
+        parts = dotted.split(".")
+        try:
+            value = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            value = raw
+        cfg = _replace_nested(cfg, parts, value, dotted)
+    return cfg
+
+
+def _coerce(value, current, dotted: str):
+    """Coerce a string override to the type of the current field value, so
+    e.g. ``zero1=false`` can't silently become a truthy string."""
+    if not isinstance(value, str) or isinstance(current, str):
+        return value
+    if isinstance(current, bool):
+        lowered = value.lower()
+        if lowered in ("true", "1", "yes"):
+            return True
+        if lowered in ("false", "0", "no"):
+            return False
+        raise ValueError(f"{dotted}: {value!r} is not a boolean")
+    if isinstance(current, (int, float)):
+        raise ValueError(
+            f"{dotted}: {value!r} is not a valid {type(current).__name__}"
+        )
+    return value
+
+
+def _replace_nested(obj, parts: list[str], value, dotted: str = ""):
+    field = parts[0]
+    if not dataclasses.is_dataclass(obj) or field not in {
+        f.name for f in dataclasses.fields(obj)
+    }:
+        raise KeyError(f"no config field {field!r} on {type(obj).__name__}")
+    if len(parts) == 1:
+        value = _coerce(value, getattr(obj, field), dotted or field)
+        return dataclasses.replace(obj, **{field: value})
+    inner = _replace_nested(getattr(obj, field), parts[1:], value, dotted)
+    return dataclasses.replace(obj, **{field: inner})

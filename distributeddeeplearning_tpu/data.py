@@ -1,0 +1,152 @@
+"""Input pipeline — replaces the reference's host-side DataLoader + H2D copy
+engine (``BASELINE.json:5``: "swap the host-side DataLoader for a
+device-prefetched ... pipeline feeding HBM directly").
+
+Design:
+- Host-side iterators yield numpy batches (synthetic generators here; Grain
+  wrappers for real datasets). Every batch is deterministic in
+  ``(seed, step)`` so runs are reproducible and shardings are comparable.
+- :func:`sharded_batches` places each host batch as a global device array
+  sharded over the batch axes of the mesh (single host: ``jax.device_put``
+  with a ``NamedSharding``; multi-host: each process contributes its local
+  shard via ``jax.make_array_from_process_local_data``).
+- :func:`prefetch` keeps a small queue of device batches ahead of the train
+  loop so H2D transfer overlaps compute (the TPU analogue of the reference's
+  copy engine / pinned-memory double buffering).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from collections.abc import Iterator
+
+import jax
+import numpy as np
+
+from .sharding import batch_sharding
+
+
+@dataclasses.dataclass
+class SyntheticImages:
+    """Deterministic random images + labels.
+
+    ``n_distinct`` > 0 cycles through that many fixed batches (a memorizable
+    toy dataset — loss must fall); 0 streams fresh batches forever (for
+    throughput benchmarks). Batch content depends only on ``(seed, index)``,
+    never on sharding, so DP-parity tests see identical data.
+    """
+
+    batch_size: int
+    image_size: int = 32
+    channels: int = 3
+    num_classes: int = 10
+    seed: int = 0
+    n_distinct: int = 8
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        if self.n_distinct:
+            index = index % self.n_distinct
+        rng = np.random.default_rng((self.seed << 20) + index)
+        return {
+            "image": rng.standard_normal(
+                (self.batch_size, self.image_size, self.image_size, self.channels),
+                dtype=np.float32,
+            ),
+            "label": rng.integers(
+                0, self.num_classes, (self.batch_size,), dtype=np.int32
+            ),
+        }
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        i = 0
+        while True:
+            yield self.batch(i)
+            i += 1
+
+
+@dataclasses.dataclass
+class SyntheticTokens:
+    """Deterministic random token sequences for LM/MLM workloads.
+
+    Yields ``{'tokens': [B, L] int32}``; task code derives inputs/targets
+    (causal shift for LM, masking for MLM) on device.
+    """
+
+    batch_size: int
+    seq_len: int = 128
+    vocab_size: int = 1024
+    seed: int = 0
+    n_distinct: int = 8
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        if self.n_distinct:
+            index = index % self.n_distinct
+        rng = np.random.default_rng((self.seed << 20) + index)
+        return {
+            "tokens": rng.integers(
+                0, self.vocab_size, (self.batch_size, self.seq_len), dtype=np.int32
+            )
+        }
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        i = 0
+        while True:
+            yield self.batch(i)
+            i += 1
+
+
+def make_dataset(kind: str, **kwargs):
+    if kind == "synthetic_image":
+        return SyntheticImages(**kwargs)
+    if kind == "synthetic_tokens":
+        return SyntheticTokens(**kwargs)
+    raise ValueError(f"unknown dataset kind {kind!r}")
+
+
+def sharded_batches(it, mesh) -> Iterator:
+    """Place each host batch on the mesh, batch dim sharded over (dp, fsdp).
+
+    Single-host: ``device_put`` of the global batch. Multi-host: each process
+    holds its local slice and contributes it to a global array.
+    """
+    sharding = batch_sharding(mesh)
+    n_proc = jax.process_count()
+    proc = jax.process_index()
+    for batch in it:
+        if n_proc > 1:
+            # Each generator yields the *global* batch deterministically; this
+            # process contributes only its contiguous slice of it.
+            def _local(x):
+                if x.shape[0] % n_proc:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"{n_proc} processes"
+                    )
+                per = x.shape[0] // n_proc
+                return jax.make_array_from_process_local_data(
+                    sharding, x[proc * per : (proc + 1) * per]
+                )
+
+            yield jax.tree.map(_local, batch)
+        else:
+            yield jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def prefetch(it, size: int = 2) -> Iterator:
+    """Keep ``size`` batches in flight ahead of consumption. device_put is
+    async, so enqueueing early overlaps H2D with the current step."""
+    queue = collections.deque()
+    it = iter(it)
+    try:
+        for _ in range(size):
+            queue.append(next(it))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(next(it))
+        except StopIteration:
+            pass
+        yield out

@@ -1,0 +1,158 @@
+"""ResNet-18/50 — workloads 1 and 2 of the reference (``BASELINE.json:7-8``:
+"ResNet-18 on CIFAR-10, single-process SGD" / "ResNet-50 on ImageNet,
+multi-chip allreduce data-parallel").
+
+TPU-first notes:
+- NHWC layout (TPU conv native layout), bf16-friendly.
+- BatchNorm statistics are computed over the *global* batch automatically:
+  under ``jit`` with a batch sharded over ``('dp','fsdp')`` the mean/var
+  reductions are global reductions, so XLA inserts the cross-replica
+  collectives itself — the reference needs explicit synced-BN/NCCL for this;
+  here it falls out of the sharding model.
+- Parameters carry logical-axis names so FSDP/TP rules apply uniformly.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from functools import partial
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+
+# Logical axis names for conv kernels (HWIO) and dense layers.
+_CONV_NAMES = ("conv_h", "conv_w", "conv_in", "embed")
+_DENSE_NAMES = ("embed", "vocab")
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: int = 3
+    strides: int = 1
+    use_relu: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(
+            self.features,
+            (self.kernel, self.kernel),
+            strides=self.strides,
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.kaiming_normal(), _CONV_NAMES
+            ),
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)),
+        )(x)
+        if self.use_relu:
+            x = nn.relu(x)
+        return x
+
+
+class BasicBlock(nn.Module):
+    """2x 3x3 convs + identity/projection shortcut (ResNet-18/34)."""
+
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = ConvBN(self.features, 3, self.strides, dtype=self.dtype)(x, train)
+        y = ConvBN(self.features, 3, 1, use_relu=False, dtype=self.dtype)(y, train)
+        if residual.shape != y.shape:
+            residual = ConvBN(
+                self.features, 1, self.strides, use_relu=False, dtype=self.dtype
+            )(x, train)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1(x4) bottleneck (ResNet-50/101/152)."""
+
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = ConvBN(self.features, 1, 1, dtype=self.dtype)(x, train)
+        y = ConvBN(self.features, 3, self.strides, dtype=self.dtype)(y, train)
+        y = ConvBN(
+            self.features * 4, 1, 1, use_relu=False, dtype=self.dtype
+        )(y, train)
+        if residual.shape != y.shape:
+            residual = ConvBN(
+                self.features * 4, 1, self.strides, use_relu=False, dtype=self.dtype
+            )(x, train)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet over NHWC inputs.
+
+    ``small_stem=True`` is the CIFAR stem (3x3/1, no maxpool); otherwise the
+    ImageNet stem (7x7/2 + 3x3/2 maxpool).
+    """
+
+    block: Callable
+    stage_sizes: Sequence[int]
+    num_classes: int
+    width: int = 64
+    small_stem: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.small_stem:
+            x = ConvBN(self.width, 3, 1, dtype=self.dtype)(x, train)
+        else:
+            x = ConvBN(self.width, 7, 2, dtype=self.dtype)(x, train)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(
+                    self.width * 2**i, strides=strides, dtype=self.dtype
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, _DENSE_NAMES
+            ),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+        )(x)
+        return x.astype(jnp.float32)
+
+
+@register("resnet18")
+def resnet18(num_classes: int = 10, width: int = 64, small_stem: bool = True,
+             dtype=jnp.float32, **_):
+    return ResNet(
+        block=BasicBlock, stage_sizes=(2, 2, 2, 2), num_classes=num_classes,
+        width=width, small_stem=small_stem, dtype=dtype,
+    )
+
+
+@register("resnet50")
+def resnet50(num_classes: int = 1000, width: int = 64, small_stem: bool = False,
+             dtype=jnp.float32, **_):
+    return ResNet(
+        block=BottleneckBlock, stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+        width=width, small_stem=small_stem, dtype=dtype,
+    )

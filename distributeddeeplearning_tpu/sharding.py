@@ -45,7 +45,8 @@ DEFAULT_LOGICAL_RULES: tuple[tuple[str, str | tuple[str, ...] | None], ...] = (
     ("vocab", "tp"),
     ("expert", "ep"),
     ("stage", "pp"),
-    ("conv_hw", None),
+    ("conv_h", None),
+    ("conv_w", None),
     ("conv_in", None),
     ("norm", None),
 )

@@ -1,0 +1,60 @@
+"""M1: config system — load, override, coercion."""
+
+import pytest
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    apply_overrides,
+    load_config,
+)
+
+
+def test_load_config_resnet18():
+    cfg = load_config("configs/resnet18_cifar10.py")
+    assert cfg.model.name == "resnet18"
+    assert cfg.data.kind == "synthetic_image"
+
+
+def test_override_nested_int_and_float():
+    cfg = apply_overrides(Config(), ["train.steps=7", "optim.lr=0.5"])
+    assert cfg.train.steps == 7
+    assert cfg.optim.lr == 0.5
+
+
+def test_override_mesh_axis():
+    cfg = apply_overrides(Config(), ["mesh.tp=4"])
+    assert cfg.mesh.tp == 4
+
+
+def test_override_bool_word_coerced():
+    cfg = apply_overrides(Config(), ["train.zero1=true"])
+    assert cfg.train.zero1 is True
+    cfg = apply_overrides(Config(), ["train.zero1=false"])
+    assert cfg.train.zero1 is False
+
+
+def test_override_bad_bool_rejected():
+    with pytest.raises(ValueError, match="not a boolean"):
+        apply_overrides(Config(), ["train.zero1=maybe"])
+
+
+def test_override_typoed_number_rejected():
+    with pytest.raises(ValueError, match="not a valid int"):
+        apply_overrides(Config(), ["train.steps=1O0"])
+
+
+def test_override_unknown_field_rejected():
+    with pytest.raises(KeyError, match="bogus"):
+        apply_overrides(Config(), ["bogus.x=1"])
+
+
+def test_override_dict_kwargs():
+    cfg = apply_overrides(Config(), ["model.kwargs={'width': 8}"])
+    assert cfg.model.kwargs == {"width": 8}
+
+
+def test_config_json_roundtrippable():
+    import json
+
+    blob = json.loads(Config().to_json())
+    assert blob["model"]["name"] == "resnet18"

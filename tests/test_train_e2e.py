@@ -1,0 +1,86 @@
+"""M1: end-to-end slice — tiny ResNet-18 on synthetic CIFAR, CPU sim.
+
+The parity test here is the template every parallelism strategy reuses
+(SURVEY.md §4 tier 2): identical seed + identical global batches must give
+(near-)identical losses whether the mesh is 1 device or 8.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu import data as data_lib
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.mesh import MeshConfig, build_mesh, single_device_mesh
+from distributeddeeplearning_tpu.train import (
+    Trainer,
+    fit,
+    get_task,
+    make_optimizer,
+)
+
+
+def tiny_resnet():
+    return models.get_model("resnet18", num_classes=10, width=8)
+
+
+def run_steps(mesh, n_steps=6, batch_size=32, grad_accum=1, seed=0):
+    model = tiny_resnet()
+    tx = make_optimizer("sgd", 0.05, momentum=0.9)
+    trainer = Trainer(
+        model, tx, get_task("classification"), mesh, grad_accum=grad_accum,
+        donate=False,
+    )
+    ds = data_lib.SyntheticImages(
+        batch_size=batch_size, image_size=16, num_classes=10, seed=seed,
+        n_distinct=4,
+    )
+    state = trainer.init(seed, ds.batch(0))
+    losses = []
+    for i, batch in enumerate(data_lib.sharded_batches(ds, mesh)):
+        if i >= n_steps:
+            break
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses, state
+
+
+def test_loss_decreases_single_device():
+    mesh = single_device_mesh()
+    losses, _ = run_steps(mesh, n_steps=10)
+    assert losses[-1] < losses[0], losses
+
+
+def test_dp8_parity_with_single_device():
+    losses_1, _ = run_steps(single_device_mesh(), n_steps=6)
+    losses_8, _ = run_steps(build_mesh(MeshConfig(dp=8)), n_steps=6)
+    np.testing.assert_allclose(losses_1, losses_8, rtol=2e-4, atol=2e-5)
+
+
+def test_state_is_sharded_and_step_advances():
+    mesh = build_mesh(MeshConfig(dp=8))
+    _, state = run_steps(mesh, n_steps=2)
+    assert int(state.step) == 2
+    # BatchNorm running stats were updated (non-zero means exist).
+    assert state.model_state and "batch_stats" in state.model_state
+
+
+def test_grad_accum_runs_and_learns():
+    # BatchNorm makes grad_accum!=1 semantically different (stats update per
+    # microbatch), so exact parity is checked on BN-free models (M3 GPT-2);
+    # here: the scan path compiles, steps, and the loss falls.
+    mesh = build_mesh(MeshConfig(dp=8))
+    losses, state = run_steps(mesh, n_steps=10, batch_size=32, grad_accum=2)
+    assert int(state.step) == 10
+    assert losses[-1] < losses[0], losses
+
+
+def test_batchnorm_global_stats_match_across_shardings():
+    # The classic DP parity breaker (SURVEY.md §7 hard part 4): BN must use
+    # global-batch statistics under dp=8 exactly as under dp=1.
+    _, s1 = run_steps(single_device_mesh(), n_steps=3)
+    _, s8 = run_steps(build_mesh(MeshConfig(dp=8)), n_steps=3)
+    m1 = jax.tree.leaves(s1.model_state)
+    m8 = jax.tree.leaves(s8.model_state)
+    for a, b in zip(m1, m8):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
