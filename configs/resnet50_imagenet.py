@@ -1,0 +1,35 @@
+"""Workload 2 (BASELINE.json:8): ResNet-50 on ImageNet, multi-chip
+allreduce data parallelism — the north-star benchmark config
+(BASELINE.json:2: "ResNet-50 ImageNet images/sec/chip").
+
+Synthetic ImageNet-shaped data; the gradient all-reduce the reference issues
+via NCCL is emitted by XLA from the batch-sharded loss mean.
+"""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(name="resnet50", kwargs={"num_classes": 1000}),
+        data=DataConfig(
+            kind="synthetic_image",
+            batch_size=256,
+            image_size=224,
+            num_classes=1000,
+            n_distinct=0,  # streaming: throughput measurement
+        ),
+        optim=OptimConfig(
+            name="sgd", lr=0.4, momentum=0.9, schedule="cosine",
+            warmup_steps=500, weight_decay=1e-4,
+        ),
+        train=TrainConfig(steps=1000, log_every=20, task="classification"),
+        mesh=MeshConfig(dp=-1),
+    )

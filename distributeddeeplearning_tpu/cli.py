@@ -17,14 +17,17 @@ from . import data as data_lib
 from . import models
 from .config import Config, apply_overrides, load_config
 from .mesh import build_mesh
+from .metrics import MetricWriter, Profiler
 from .train import Trainer, fit, get_task, make_optimizer
 from .utils.pytree import tree_size
 
 
 def build_all(cfg: Config):
-    """Construct (mesh, model, trainer, batches) from a config."""
+    """Construct (mesh, model, trainer, dataset) from a config."""
     mesh = build_mesh(cfg.mesh)
-    model = models.get_model(cfg.model.name, **cfg.model.kwargs)
+    model = models.get_model(
+        cfg.model.name, remat=cfg.train.remat, **cfg.model.kwargs
+    )
     tx = make_optimizer(
         cfg.optim.name,
         cfg.optim.lr,
@@ -43,25 +46,61 @@ def build_all(cfg: Config):
         get_task(cfg.train.task),
         mesh,
         grad_accum=cfg.train.grad_accum,
+        zero1=cfg.train.zero1,
     )
     dataset = data_lib.make_dataset(cfg.data.kind, **cfg.data.dataset_kwargs())
-    batches = data_lib.prefetch(data_lib.sharded_batches(dataset, mesh))
-    return mesh, model, trainer, dataset, batches
+    return mesh, model, trainer, dataset
 
 
 def cmd_train(cfg: Config) -> int:
-    mesh, _, trainer, dataset, batches = build_all(cfg)
+    mesh, _, trainer, dataset = build_all(cfg)
     print(f"devices: {jax.device_count()}  mesh: {dict(mesh.shape)}")
-    state = trainer.init(cfg.train.seed, dataset.batch(0))
+
+    ckpt = None
+    start_index = 0
+    state = None
+    if cfg.train.checkpoint_dir:
+        from .checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+        if ckpt.latest_step() is not None:
+            # Resume: no init materialization — restore straight into the
+            # mesh placement computed by setup().
+            trainer.setup(dataset.batch(0))
+            state, data_state = ckpt.restore(
+                trainer.abstract_state_with_shardings()
+            )
+            start_index = int(data_state.get("next_index", int(state.step)))
+            print(f"resumed from step {int(state.step)}")
+    if state is None:
+        state = trainer.init(cfg.train.seed, dataset.batch(0))
     print(f"model: {cfg.model.name}  params: {tree_size(state.params):,}")
-    fit(
-        trainer,
-        state,
-        batches,
-        steps=cfg.train.steps,
-        log_every=cfg.train.log_every,
-        log_fn=lambda m: print(json.dumps(m)),
+
+    batches = data_lib.prefetch(
+        data_lib.sharded_batches(dataset.iter_from(start_index), mesh)
     )
+    writer = MetricWriter(cfg.train.log_dir)
+    profiler = Profiler(cfg.train.profile_steps, cfg.train.log_dir)
+    try:
+        fit(
+            trainer,
+            state,
+            batches,
+            steps=cfg.train.steps,
+            log_every=cfg.train.log_every,
+            log_fn=lambda m: print(json.dumps(m)),
+            writer=writer,
+            profiler=profiler,
+            ckpt=ckpt,
+            save_every=cfg.train.save_every,
+        )
+    finally:
+        # Always drain the async checkpoint queue — an abandoned in-flight
+        # save would silently roll resume back by save_every steps.
+        if ckpt is not None:
+            ckpt.wait()
+            ckpt.close()
+        writer.close()
     return 0
 
 

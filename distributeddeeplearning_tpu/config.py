@@ -74,6 +74,7 @@ class TrainConfig:
     checkpoint_dir: str = ""
     save_every: int = 0
     eval_every: int = 0
+    log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
 
 

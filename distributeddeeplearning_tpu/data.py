@@ -27,8 +27,26 @@ import numpy as np
 from .sharding import batch_sharding
 
 
+class IndexedDataset:
+    """Base for datasets addressable by batch index: ``batch(i)`` is pure and
+    deterministic, which is what makes resume step-exact and parity tests
+    sharding-independent."""
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def iter_from(self, start: int = 0) -> Iterator[dict[str, np.ndarray]]:
+        i = start
+        while True:
+            yield self.batch(i)
+            i += 1
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return self.iter_from(0)
+
+
 @dataclasses.dataclass
-class SyntheticImages:
+class SyntheticImages(IndexedDataset):
     """Deterministic random images + labels.
 
     ``n_distinct`` > 0 cycles through that many fixed batches (a memorizable
@@ -58,15 +76,9 @@ class SyntheticImages:
             ),
         }
 
-    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
-        i = 0
-        while True:
-            yield self.batch(i)
-            i += 1
-
 
 @dataclasses.dataclass
-class SyntheticTokens:
+class SyntheticTokens(IndexedDataset):
     """Deterministic random token sequences for LM/MLM workloads.
 
     Yields ``{'tokens': [B, L] int32}``; task code derives inputs/targets
@@ -88,12 +100,6 @@ class SyntheticTokens:
                 0, self.vocab_size, (self.batch_size, self.seq_len), dtype=np.int32
             )
         }
-
-    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
-        i = 0
-        while True:
-            yield self.batch(i)
-            i += 1
 
 
 def make_dataset(kind: str, **kwargs):

@@ -1,0 +1,73 @@
+"""Metrics / observability.
+
+On-device scalars are pulled to host only every ``log_every`` steps (a D2H
+sync point — keep it rare); process 0 writes TensorBoard summaries via clu.
+``profile_window`` wires ``jax.profiler`` traces (viewable in TensorBoard's
+profile plugin) into the step loop — the TPU counterpart of the reference's
+nsys/nvprof story.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class MetricWriter:
+    """TensorBoard scalar writer (process 0 only); no-op without a logdir."""
+
+    def __init__(self, logdir: str | None):
+        self._writer = None
+        if logdir and jax.process_index() == 0:
+            from clu import metric_writers
+
+            self._writer = metric_writers.create_default_writer(
+                logdir, asynchronous=True
+            )
+
+    def write(self, step: int, scalars: dict[str, float]):
+        if self._writer is not None:
+            self._writer.write_scalars(step, scalars)
+
+    def flush(self):
+        if self._writer is not None:
+            self._writer.flush()
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+
+
+def parse_profile_window(spec: str) -> tuple[int, int] | None:
+    """'12:20' -> (12, 20); '' -> None."""
+    if not spec:
+        return None
+    a, _, b = spec.partition(":")
+    start, stop = int(a), int(b or int(a) + 5)
+    if stop <= start:
+        raise ValueError(f"profile window {spec!r}: stop must be > start")
+    return start, stop
+
+
+class Profiler:
+    """Starts/stops a jax.profiler trace around a step window."""
+
+    def __init__(self, window: str, logdir: str):
+        self._window = parse_profile_window(window)
+        self._logdir = logdir or "/tmp/ddl_profile"
+        self._active = False
+
+    def step(self, i: int):
+        if self._window is None or jax.process_index() != 0:
+            return
+        start, stop = self._window
+        if i == start and not self._active:
+            jax.profiler.start_trace(self._logdir)
+            self._active = True
+        elif i >= stop and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

@@ -17,6 +17,7 @@ from __future__ import annotations
 from collections.abc import Callable, Sequence
 from functools import partial
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -113,21 +114,39 @@ class ResNet(nn.Module):
     num_classes: int
     width: int = 64
     small_stem: bool = False
+    remat: str = "none"  # none | full | dots — activation checkpointing
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        block_cls = self.block
+        if self.remat != "none":
+            # Per-block rematerialization (reference workload 5 uses
+            # "DP + activation checkpointing", BASELINE.json:11): recompute
+            # block activations in the backward pass instead of saving them.
+            policy = {
+                "full": None,
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }[self.remat]
+            block_cls = nn.remat(block_cls, static_argnums=(2,), policy=policy)
         if self.small_stem:
             x = ConvBN(self.width, 3, 1, dtype=self.dtype)(x, train)
         else:
             x = ConvBN(self.width, 7, 2, dtype=self.dtype)(x, train)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        # Explicit names: nn.remat renames the class (CheckpointBasicBlock_*),
+        # which would change param paths and therefore per-param init RNGs —
+        # pinning names keeps the param tree (and its init) identical with
+        # remat on or off.
+        k = 0
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block(
-                    self.width * 2**i, strides=strides, dtype=self.dtype
+                x = block_cls(
+                    self.width * 2**i, strides=strides, dtype=self.dtype,
+                    name=f"{self.block.__name__}_{k}",
                 )(x, train)
+                k += 1
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(
             self.num_classes,
@@ -142,17 +161,17 @@ class ResNet(nn.Module):
 
 @register("resnet18")
 def resnet18(num_classes: int = 10, width: int = 64, small_stem: bool = True,
-             dtype=jnp.float32, **_):
+             remat: str = "none", dtype=jnp.float32, **_):
     return ResNet(
         block=BasicBlock, stage_sizes=(2, 2, 2, 2), num_classes=num_classes,
-        width=width, small_stem=small_stem, dtype=dtype,
+        width=width, small_stem=small_stem, remat=remat, dtype=dtype,
     )
 
 
 @register("resnet50")
 def resnet50(num_classes: int = 1000, width: int = 64, small_stem: bool = False,
-             dtype=jnp.float32, **_):
+             remat: str = "none", dtype=jnp.float32, **_):
     return ResNet(
         block=BottleneckBlock, stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
-        width=width, small_stem=small_stem, dtype=dtype,
+        width=width, small_stem=small_stem, remat=remat, dtype=dtype,
     )

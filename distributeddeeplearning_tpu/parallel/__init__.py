@@ -1,0 +1,13 @@
+"""Parallelism strategies.
+
+Every strategy is expressed against the single named mesh (``mesh.py``) —
+there are no per-strategy communicators or process groups (the reference
+manages NCCL groups per strategy; ``BASELINE.json:5``). Modules:
+
+- ``zero``       ZeRO-1 optimizer-state sharding (workload 4, BASELINE.json:10)
+- ``tp``         Megatron-style tensor parallelism + sequence parallelism
+- ``pp``         pipeline parallelism (shard_map + ppermute microbatch schedule)
+- ``sp_ring``    ring attention / context parallelism
+- ``sp_ulysses`` Ulysses all-to-all sequence parallelism
+- ``ep``         expert parallelism (MoE)
+"""

@@ -1,0 +1,63 @@
+"""ZeRO-1: optimizer-state sharding over the data-parallel axes.
+
+Reference workload 4 (``BASELINE.json:10``: "GPT-2 124M LM, ZeRO-1
+optimizer-state sharding") keeps one 1/N slice of Adam moments per rank and
+orchestrates reduce-scatter / all-gather by hand over NCCL. TPU-natively this
+is purely a *placement* decision: give each optimizer-state leaf a
+NamedSharding over ``('dp','fsdp')`` and leave everything else to the XLA
+partitioner, which turns the gradient all-reduce + sharded moment update +
+replicated parameter write into reduce-scatter + local update + all-gather
+(the "automatic cross-replica sharding of weight update" pattern,
+``PAPERS.md:6``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..mesh import BATCH_AXES
+
+
+def shard_opt_state_shardings(
+    opt_shardings,
+    abs_opt_state,
+    mesh: Mesh,
+    axes: tuple[str, ...] = BATCH_AXES,
+):
+    """Add the data-parallel axes to each optimizer-state leaf's sharding.
+
+    For every array leaf, any of ``axes`` not already used by its inherited
+    spec (e.g. TP-sharded moments keep their 'tp' placement) is laid onto the
+    first free, evenly-divisible dimension. Scalars (step counts) and leaves
+    with no suitable dimension stay as they are.
+    """
+    def rewrite(sharding, abs_leaf):
+        shape = getattr(abs_leaf, "shape", ())
+        if not isinstance(sharding, NamedSharding) or not shape:
+            return sharding
+        spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+        used = {
+            ax
+            for e in spec
+            if e is not None
+            for ax in (e if isinstance(e, tuple) else (e,))
+        }
+        # Axes still available for the optimizer shard; adding a size-1 axis
+        # would be a placement no-op, so those are skipped too.
+        add = tuple(
+            a for a in axes if a not in used and mesh.shape[a] > 1
+        )
+        n = math.prod(mesh.shape[a] for a in add)
+        if n == 1:
+            return sharding
+        for d, dim in enumerate(shape):
+            if spec[d] is None and dim % n == 0 and dim >= n:
+                spec[d] = add
+                return NamedSharding(mesh, P(*spec))
+        return sharding
+
+    return jax.tree.map(rewrite, opt_shardings, abs_opt_state)

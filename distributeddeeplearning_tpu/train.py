@@ -164,6 +164,7 @@ class Trainer:
         mesh: Mesh,
         rules=DEFAULT_LOGICAL_RULES,
         grad_accum: int = 1,
+        zero1: bool = False,
         donate: bool = True,
     ):
         self.model = model
@@ -172,10 +173,12 @@ class Trainer:
         self.mesh = mesh
         self.rules = rules
         self.grad_accum = grad_accum
+        self.zero1 = zero1
         self._donate = donate
         self._train_step = None
         self._eval_step = None
         self.state_shardings = None
+        self.abstract_state = None
 
     # -- init ---------------------------------------------------------------
 
@@ -194,26 +197,59 @@ class Trainer:
             rng=s_rng,
         )
 
-    def init(self, seed: int, example_batch) -> TrainState:
-        """Initialize the sharded TrainState.
-
-        The placement implied by ``out_shardings`` is the TPU version of the
-        reference's init-time NCCL parameter broadcast.
-        """
-        rng = jax.random.key(seed)
-        example_inputs = jax.tree.map(
+    def setup(self, example_batch) -> None:
+        """Infer the state tree and its shardings (abstractly — nothing is
+        materialized). Needed before ``init`` / ``train_step`` / restore."""
+        if self.state_shardings is not None:
+            return
+        self._example_inputs = jax.tree.map(
             lambda x: jnp.asarray(x), self.task.input_fn(example_batch)
         )
+        # Raw uint32 keys (not typed PRNG keys): they checkpoint as plain
+        # arrays through orbax.
         abs_state = jax.eval_shape(
-            lambda r: self._init_fn(r, example_inputs), rng
+            lambda r: self._init_fn(r, self._example_inputs),
+            jax.random.PRNGKey(0),
         )
         specs = nn.get_partition_spec(abs_state)
+        self.abstract_state = nn.meta.unbox(abs_state)
         self.state_shardings = logical_to_mesh_sharding(specs, self.mesh, self.rules)
+        if self.zero1:
+            from .parallel.zero import shard_opt_state_shardings
+
+            self.state_shardings = self.state_shardings.replace(
+                opt_state=shard_opt_state_shardings(
+                    self.state_shardings.opt_state,
+                    self.abstract_state.opt_state,
+                    self.mesh,
+                )
+            )
+
+    def init(self, seed: int, example_batch) -> TrainState:
+        """Initialize and materialize the sharded TrainState.
+
+        The placement implied by ``out_shardings`` is the TPU version of the
+        reference's init-time NCCL parameter broadcast. Resume flows call
+        ``setup()`` + ``CheckpointManager.restore`` instead, skipping the
+        materialization entirely.
+        """
+        self.setup(example_batch)
         init = jax.jit(
-            lambda r: nn.meta.unbox(self._init_fn(r, example_inputs)),
+            lambda r: nn.meta.unbox(self._init_fn(r, self._example_inputs)),
             out_shardings=self.state_shardings,
         )
-        return init(rng)
+        return init(jax.random.PRNGKey(seed))
+
+    def abstract_state_with_shardings(self):
+        """ShapeDtypeStructs carrying shardings — what orbax needs to restore
+        a checkpoint directly into the live mesh layout."""
+        if self.abstract_state is None:
+            raise RuntimeError("call Trainer.init() before restore")
+        return jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            self.abstract_state,
+            self.state_shardings,
+        )
 
     # -- steps --------------------------------------------------------------
 
@@ -338,19 +374,41 @@ def fit(
     steps: int,
     log_every: int = 10,
     log_fn=print,
+    writer=None,
+    profiler=None,
+    ckpt=None,
+    save_every: int = 0,
 ) -> tuple[TrainState, list[dict]]:
-    """Simple host loop: step, periodically pull metrics. Returns final state
-    and the logged history."""
+    """Host step loop.
+
+    Resumes from ``state.step`` (callers align ``batches`` to the same
+    index). Metrics are pulled to host only every ``log_every`` steps;
+    checkpoint saves are async and off the loop.
+    """
     history = []
+    start = int(state.step)
     t0 = time.perf_counter()
-    for i, batch in enumerate(batches):
-        if i >= steps:
+    it = iter(batches)
+    for i in range(start, steps):
+        try:
+            batch = next(it)
+        except StopIteration:
             break
         state, metrics = trainer.train_step(state, batch)
+        if profiler is not None:
+            profiler.step(i)
         if log_every and (i + 1) % log_every == 0:
             m = {k: float(v) for k, v in metrics.items()}
             m["step"] = i + 1
             m["wall_s"] = round(time.perf_counter() - t0, 3)
             history.append(m)
             log_fn(m)
+            if writer is not None:
+                writer.write(i + 1, {k: v for k, v in m.items() if k != "step"})
+        if ckpt is not None and save_every and (i + 1) % save_every == 0:
+            ckpt.save(i + 1, state, {"next_index": i + 1})
+    if profiler is not None:
+        profiler.close()
+    if writer is not None:
+        writer.flush()
     return state, history

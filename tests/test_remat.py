@@ -1,0 +1,36 @@
+"""M2: activation checkpointing (remat) — numerics must be unchanged."""
+
+import numpy as np
+
+from distributeddeeplearning_tpu import data as data_lib
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.mesh import single_device_mesh
+from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
+
+
+def run(remat: str, n_steps: int = 4):
+    mesh = single_device_mesh()
+    model = models.get_model("resnet18", num_classes=10, width=8, remat=remat)
+    tx = make_optimizer("sgd", 0.05, momentum=0.9)
+    trainer = Trainer(
+        model, tx, get_task("classification"), mesh, donate=False
+    )
+    ds = data_lib.SyntheticImages(
+        batch_size=16, image_size=16, num_classes=10, seed=0, n_distinct=4
+    )
+    state = trainer.init(0, ds.batch(0))
+    losses = []
+    for i, batch in enumerate(data_lib.sharded_batches(ds, mesh)):
+        if i >= n_steps:
+            break
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def test_remat_full_matches_none():
+    np.testing.assert_allclose(run("none"), run("full"), rtol=1e-5)
+
+
+def test_remat_dots_matches_none():
+    np.testing.assert_allclose(run("none"), run("dots"), rtol=1e-5)
