@@ -1,0 +1,31 @@
+"""Workload 3 (BASELINE.json:9): BERT-base MLM (Wikipedia), DP + gradient
+accumulation. Synthetic masked-token batches; host-side masking collator."""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(
+            name="bert",
+            kwargs={"size": "base", "vocab_size": 30522, "max_len": 512},
+        ),
+        data=DataConfig(
+            kind="synthetic_mlm", batch_size=64, seq_len=128, vocab_size=30522,
+        ),
+        optim=OptimConfig(
+            name="adamw", lr=1e-4, weight_decay=0.01, schedule="linear",
+            warmup_steps=100, grad_clip=1.0,
+        ),
+        train=TrainConfig(
+            steps=1000, log_every=20, task="mlm", grad_accum=4,
+        ),
+        mesh=MeshConfig(dp=-1),
+    )

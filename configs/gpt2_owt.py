@@ -1,0 +1,29 @@
+"""Workload 4 (BASELINE.json:10): GPT-2 124M LM (OpenWebText), ZeRO-1
+optimizer-state sharding. Synthetic token stream."""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(
+            name="gpt2", kwargs={"size": "124m", "max_len": 1024}
+        ),
+        data=DataConfig(
+            kind="synthetic_tokens", batch_size=32, seq_len=1024,
+            vocab_size=50257,
+        ),
+        optim=OptimConfig(
+            name="adamw", lr=6e-4, b2=0.95, weight_decay=0.1,
+            schedule="cosine", warmup_steps=200, grad_clip=1.0,
+        ),
+        train=TrainConfig(steps=1000, log_every=20, task="lm", zero1=True),
+        mesh=MeshConfig(dp=-1),
+    )

@@ -1,0 +1,29 @@
+"""Workload 5 (BASELINE.json:11): ViT-L/16 on ImageNet-21k, DP + activation
+checkpointing. Synthetic 224x224 images, 21k classes."""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(name="vit", kwargs={"size": "l16"}),
+        data=DataConfig(
+            kind="synthetic_image", batch_size=64, image_size=224,
+            num_classes=21843,
+        ),
+        optim=OptimConfig(
+            name="adamw", lr=1e-3, weight_decay=0.05, schedule="cosine",
+            warmup_steps=500, grad_clip=1.0,
+        ),
+        train=TrainConfig(
+            steps=1000, log_every=20, task="classification", remat="full",
+        ),
+        mesh=MeshConfig(dp=-1),
+    )

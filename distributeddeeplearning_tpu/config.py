@@ -36,17 +36,19 @@ class DataConfig:
     seed: int = 0
 
     def dataset_kwargs(self) -> dict[str, Any]:
-        common = {"batch_size": self.batch_size, "seed": self.seed,
-                  "n_distinct": self.n_distinct}
-        if self.kind == "synthetic_image":
-            return common | {
-                "image_size": self.image_size,
-                "channels": self.channels,
-                "num_classes": self.num_classes,
-            }
-        if self.kind == "synthetic_tokens":
-            return common | {"seq_len": self.seq_len, "vocab_size": self.vocab_size}
-        return common
+        """Kwargs for this kind's dataset class: the intersection of its
+        dataclass fields with this config's — derived from the one registry
+        in ``data.py`` so a new kind cannot silently drop overrides."""
+        from .data import DATASET_KINDS
+
+        if self.kind not in DATASET_KINDS:
+            raise ValueError(f"unknown dataset kind {self.kind!r}")
+        cls_fields = {f.name for f in dataclasses.fields(DATASET_KINDS[self.kind])}
+        return {
+            k: getattr(self, k)
+            for k in cls_fields
+            if k != "kind" and hasattr(self, k)
+        }
 
 
 @dataclasses.dataclass(frozen=True)

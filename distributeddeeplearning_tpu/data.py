@@ -79,10 +79,11 @@ class SyntheticImages(IndexedDataset):
 
 @dataclasses.dataclass
 class SyntheticTokens(IndexedDataset):
-    """Deterministic random token sequences for LM/MLM workloads.
+    """Deterministic random token sequences for causal-LM workloads.
 
-    Yields ``{'tokens': [B, L] int32}``; task code derives inputs/targets
-    (causal shift for LM, masking for MLM) on device.
+    Yields ``{'tokens': [B, L] int32}``; the LM task derives inputs/targets
+    by causal shift on device. (MLM uses :class:`SyntheticMLM`, which masks
+    host-side.)
     """
 
     batch_size: int
@@ -102,12 +103,48 @@ class SyntheticTokens(IndexedDataset):
         }
 
 
+@dataclasses.dataclass
+class SyntheticMLM(IndexedDataset):
+    """MLM batches with host-side masking (the data-collator approach): 15%
+    of positions replaced by ``mask_token_id`` in ``input_tokens``; ``labels``
+    holds the original token there and -1 (ignore) elsewhere. Masking depends
+    only on ``(seed, index)`` — resume-deterministic.
+    """
+
+    batch_size: int
+    seq_len: int = 128
+    vocab_size: int = 1024
+    mask_prob: float = 0.15
+    mask_token_id: int = 3
+    seed: int = 0
+    n_distinct: int = 8
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        if self.n_distinct:
+            index = index % self.n_distinct
+        rng = np.random.default_rng((self.seed << 20) + index)
+        tokens = rng.integers(
+            10, self.vocab_size, (self.batch_size, self.seq_len), dtype=np.int32
+        )
+        masked = rng.random(tokens.shape) < self.mask_prob
+        inputs = np.where(masked, np.int32(self.mask_token_id), tokens)
+        labels = np.where(masked, tokens, np.int32(-1))
+        return {"input_tokens": inputs, "labels": labels}
+
+
+# Single registry: config.dataset_kwargs derives its field intersection from
+# this, so a new kind cannot desync config plumbing from the dataset class.
+DATASET_KINDS: dict[str, type] = {
+    "synthetic_image": SyntheticImages,
+    "synthetic_tokens": SyntheticTokens,
+    "synthetic_mlm": SyntheticMLM,
+}
+
+
 def make_dataset(kind: str, **kwargs):
-    if kind == "synthetic_image":
-        return SyntheticImages(**kwargs)
-    if kind == "synthetic_tokens":
-        return SyntheticTokens(**kwargs)
-    raise ValueError(f"unknown dataset kind {kind!r}")
+    if kind not in DATASET_KINDS:
+        raise ValueError(f"unknown dataset kind {kind!r}")
+    return DATASET_KINDS[kind](**kwargs)
 
 
 def sharded_batches(it, mesh) -> Iterator:

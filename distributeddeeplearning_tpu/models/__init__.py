@@ -31,4 +31,4 @@ def available() -> list[str]:
     return sorted(_REGISTRY)
 
 
-from . import resnet  # noqa: E402,F401  (registers resnet18/resnet50)
+from . import bert, gpt2, resnet, vit  # noqa: E402,F401  (register models)

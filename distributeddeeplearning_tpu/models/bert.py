@@ -1,0 +1,114 @@
+"""BERT masked-LM — reference workload 3 (``BASELINE.json:9``: "BERT-base MLM
+(Wikipedia), DP + gradient accumulation").
+
+Faithful BERT architecture (post-LN, exact GELU, LN eps 1e-12, word+position
++token-type embeddings with embedding LayerNorm, MLM transform head, decoder
+tied to word embeddings + bias) so golden tests can port weights from
+``transformers.BertForMaskedLM``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from ..sharding import constrain
+from .transformer import TransformerStack, gelu_exact, layer_norm
+
+
+class BertMLM(nn.Module):
+    vocab_size: int = 30522
+    max_len: int = 512
+    type_vocab_size: int = 2
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    dropout_rate: float = 0.0
+    remat: str = "none"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, token_type_ids=None,
+                 train: bool = False):
+        B, L = tokens.shape
+        if L > self.max_len:
+            # XLA gather clamps OOB indices silently — fail loudly instead.
+            raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(tokens)
+        word = nn.Embed(
+            self.vocab_size,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="word_embeddings",
+        )
+        pos = nn.Embed(
+            self.max_len,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("pos", "embed")
+            ),
+            name="position_embeddings",
+        )
+        typ = nn.Embed(
+            self.type_vocab_size,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("pos", "embed")
+            ),
+            name="token_type_embeddings",
+        )
+        x = word(tokens) + pos(jnp.arange(L)[None, :]) + typ(token_type_ids)
+        x = layer_norm(1e-12, self.dtype, "embeddings_ln")(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = constrain(x, "batch", "seq", "embed")
+        x = TransformerStack(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.embed_dim // self.num_heads,
+            mlp_dim=4 * self.embed_dim,
+            pre_ln=False,
+            causal=False,
+            activation="gelu_exact",
+            ln_eps=1e-12,
+            dropout_rate=self.dropout_rate,
+            remat=self.remat,
+            dtype=self.dtype,
+            name="encoder",
+        )(x, attention_mask, not train)
+
+        # MLM head: transform (dense + gelu + LN), then decode tied to word
+        # embeddings plus a free bias.
+        x = nn.Dense(
+            self.embed_dim,
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "mlp")
+            ),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+            name="mlm_transform",
+        )(x)
+        x = gelu_exact(x)
+        x = layer_norm(1e-12, self.dtype, "mlm_ln")(x)
+        logits = word.attend(x)
+        bias = self.param(
+            "mlm_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+            (self.vocab_size,),
+        )
+        return (logits + bias).astype(jnp.float32)
+
+
+@register("bert")
+def bert(size: str = "base", **kwargs):
+    sizes = {"tiny": (2, 4, 64), "base": (12, 12, 768), "large": (24, 16, 1024)}
+    n_l, n_h, d = sizes[size]
+    defaults = dict(num_layers=n_l, num_heads=n_h, embed_dim=d)
+    defaults.update(kwargs)
+    return BertMLM(**defaults)

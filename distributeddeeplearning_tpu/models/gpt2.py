@@ -1,0 +1,92 @@
+"""GPT-2 causal LM — reference workload 4 (``BASELINE.json:10``: "GPT-2 124M
+LM (OpenWebText), ZeRO-1 optimizer-state sharding").
+
+Faithful GPT-2 architecture (pre-LN, gelu_new/tanh, learned positions, tied
+LM head, LN eps 1e-5) so golden tests can port weights from
+``transformers.GPT2LMHeadModel`` and compare logits exactly. Default size is
+the reference's 124M config (12L, 12H, 768d, vocab 50257).
+
+This is also the long-context testbed: sequence activations are constrained
+to the 'cp' axis. (An MoE variant swapping the MLP for expert-parallel
+routing is planned alongside parallel/ep.py.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from ..sharding import constrain
+from .transformer import TransformerStack, layer_norm
+
+
+class GPT2(nn.Module):
+    vocab_size: int = 50257
+    max_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    dropout_rate: float = 0.0
+    remat: str = "none"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, L = tokens.shape
+        if L > self.max_len:
+            # XLA gather clamps OOB indices silently — fail loudly instead.
+            raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
+        wte = nn.Embed(
+            self.vocab_size,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="wte",
+        )
+        wpe = nn.Embed(
+            self.max_len,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.01), ("pos", "embed")
+            ),
+            name="wpe",
+        )
+        x = wte(tokens) + wpe(jnp.arange(L)[None, :])
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = constrain(x, "batch", "seq", "embed")
+        x = TransformerStack(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.embed_dim // self.num_heads,
+            mlp_dim=4 * self.embed_dim,
+            pre_ln=True,
+            causal=True,
+            activation="gelu_tanh",
+            ln_eps=1e-5,
+            dropout_rate=self.dropout_rate,
+            remat=self.remat,
+            dtype=self.dtype,
+            name="h",
+        )(x, None, not train)
+        x = layer_norm(1e-5, self.dtype, "ln_f")(x)
+        # Tied LM head (GPT-2 shares wte with the output projection).
+        logits = wte.attend(x)
+        return logits.astype(jnp.float32)
+
+
+@register("gpt2")
+def gpt2(size: str = "124m", **kwargs):
+    sizes = {
+        # (layers, heads, embed) — 124m is the reference workload's config.
+        "tiny": (2, 4, 64),
+        "124m": (12, 12, 768),
+        "350m": (24, 16, 1024),
+    }
+    n_l, n_h, d = sizes[size]
+    defaults = dict(num_layers=n_l, num_heads=n_h, embed_dim=d)
+    defaults.update(kwargs)
+    return GPT2(**defaults)

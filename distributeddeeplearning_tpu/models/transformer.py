@@ -1,0 +1,231 @@
+"""Shared transformer backbone for GPT-2 / BERT / ViT (+ MoE variants).
+
+One block implementation covers all three reference transformer workloads
+(``BASELINE.json:9-11``) via flags: pre-LN (GPT-2, ViT) vs post-LN (BERT),
+causal vs bidirectional attention, exact vs tanh-approx GELU, per-model LN
+epsilon.
+
+TPU-first design:
+- weights carry logical axes: attention projections ('embed','heads','kv'),
+  MLP ('embed','mlp') — so Megatron TP = the rules table mapping heads/mlp
+  to the 'tp' mesh axis, with XLA inserting the block-boundary collectives;
+- activations are constrained to ('batch','seq','embed') between blocks
+  (sequence dim on 'cp' enables ring/Ulysses context parallelism);
+- attention softmax in fp32 regardless of compute dtype (bf16-safe);
+- block names are pinned so remat cannot perturb param-init RNG paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..sharding import constrain
+
+Dtype = jnp.dtype
+
+
+def gelu_exact(x):
+    return 0.5 * x * (1.0 + jax.lax.erf(x / np.sqrt(2.0).astype(x.dtype)))
+
+
+def gelu_tanh(x):
+    # GPT-2's "gelu_new".
+    return nn.gelu(x, approximate=True)
+
+
+def dense_init(scale: float = 0.02):
+    return nn.initializers.normal(stddev=scale)
+
+
+class SelfAttention(nn.Module):
+    """Multi-head self-attention with logical-axis-annotated projections."""
+
+    num_heads: int
+    head_dim: int
+    causal: bool = False
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.float32
+    init_scale: float = 0.02
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        features = x.shape[-1]
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(self.num_heads, self.head_dim),
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                dense_init(self.init_scale), ("embed", "heads", "kv")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("heads", "kv")
+            ),
+            name=name,
+        )
+        q = proj("query")(x)
+        k = proj("key")(x)
+        v = proj("value")(x)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / np.sqrt(self.head_dim)
+        if self.causal:
+            q_len, k_len = scores.shape[-2], scores.shape[-1]
+            causal_mask = jnp.tril(jnp.ones((q_len, k_len), bool))
+            scores = jnp.where(causal_mask[None, None], scores, -1e30)
+        if mask is not None:
+            # mask: [batch, k_len] (1 = attend) or broadcastable to scores.
+            if mask.ndim == 2:
+                mask = mask[:, None, None, :]
+            scores = jnp.where(mask.astype(bool), scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        probs = nn.Dropout(self.dropout_rate, deterministic=deterministic)(probs)
+
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.DenseGeneral(
+            features=features,
+            axis=(-2, -1),
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                dense_init(self.init_scale), ("heads", "kv", "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)
+            ),
+            name="out",
+        )(out)
+        return out
+
+
+class Mlp(nn.Module):
+    hidden_dim: int
+    activation: str = "gelu_exact"  # gelu_exact | gelu_tanh
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.float32
+    init_scale: float = 0.02
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        features = x.shape[-1]
+        act = {"gelu_exact": gelu_exact, "gelu_tanh": gelu_tanh}[self.activation]
+        h = nn.Dense(
+            self.hidden_dim,
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                dense_init(self.init_scale), ("embed", "mlp")
+            ),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+            name="fc_in",
+        )(x)
+        h = act(h)
+        h = nn.Dense(
+            features,
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                dense_init(self.init_scale), ("mlp", "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)
+            ),
+            name="fc_out",
+        )(h)
+        return nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
+
+
+def layer_norm(eps: float, dtype, name: str):
+    return nn.LayerNorm(
+        epsilon=eps,
+        dtype=dtype,
+        scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)),
+        name=name,
+    )
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    pre_ln: bool = True
+    causal: bool = False
+    activation: str = "gelu_exact"
+    ln_eps: float = 1e-5
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.float32
+    init_scale: float = 0.02
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        attn = SelfAttention(
+            self.num_heads,
+            self.head_dim,
+            causal=self.causal,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            init_scale=self.init_scale,
+            name="attn",
+        )
+        mlp = Mlp(
+            self.mlp_dim,
+            activation=self.activation,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            init_scale=self.init_scale,
+            name="mlp",
+        )
+        ln1 = layer_norm(self.ln_eps, self.dtype, "ln1")
+        ln2 = layer_norm(self.ln_eps, self.dtype, "ln2")
+        drop = nn.Dropout(self.dropout_rate, deterministic=deterministic)
+
+        if self.pre_ln:  # GPT-2 / ViT
+            x = x + drop(attn(ln1(x), mask, deterministic))
+            x = x + mlp(ln2(x), deterministic)
+        else:  # BERT
+            x = ln1(x + drop(attn(x, mask, deterministic)))
+            x = ln2(x + mlp(x, deterministic))
+        return constrain(x, "batch", "seq", "embed")
+
+
+class TransformerStack(nn.Module):
+    """N identically-configured blocks with pinned names and optional remat."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    pre_ln: bool = True
+    causal: bool = False
+    activation: str = "gelu_exact"
+    ln_eps: float = 1e-5
+    dropout_rate: float = 0.0
+    remat: str = "none"
+    dtype: Dtype = jnp.float32
+    init_scale: float = 0.02
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        block_cls = TransformerBlock
+        if self.remat != "none":
+            policy = {
+                "full": None,
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }[self.remat]
+            block_cls = nn.remat(
+                block_cls, static_argnums=(3,), policy=policy
+            )
+        for i in range(self.num_layers):
+            x = block_cls(
+                self.num_heads,
+                self.head_dim,
+                self.mlp_dim,
+                pre_ln=self.pre_ln,
+                causal=self.causal,
+                activation=self.activation,
+                ln_eps=self.ln_eps,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                init_scale=self.init_scale,
+                name=f"block_{i}",
+            )(x, mask, deterministic)
+        return x

@@ -1,0 +1,108 @@
+"""ViT image classifier — reference workload 5 (``BASELINE.json:11``:
+"ViT-L/16 on ImageNet-21k, DP + activation checkpointing").
+
+Faithful ViT architecture (conv patch embedding, CLS token, learned position
+embeddings, pre-LN encoder, exact GELU, LN eps 1e-12) so golden tests can
+port weights from ``transformers.ViTForImageClassification``. Default is
+ViT-L/16; it is also the remat testbed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from ..sharding import constrain
+from .transformer import TransformerStack, layer_norm
+
+
+class ViT(nn.Module):
+    num_classes: int = 21843  # ImageNet-21k
+    image_size: int = 224
+    patch_size: int = 16
+    num_layers: int = 24
+    num_heads: int = 16
+    embed_dim: int = 1024
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    remat: str = "none"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        B = images.shape[0]
+        x = nn.Conv(
+            self.embed_dim,
+            (self.patch_size, self.patch_size),
+            strides=self.patch_size,
+            padding="VALID",
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("conv_h", "conv_w", "conv_in", "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)
+            ),
+            name="patch_embed",
+        )(images)
+        x = x.reshape(B, -1, self.embed_dim)  # [B, n_patches, D]
+        cls = self.param(
+            "cls_token",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("pos", "embed")),
+            (1, self.embed_dim),
+        )
+        x = jnp.concatenate([jnp.tile(cls[None], (B, 1, 1)), x], axis=1)
+        n_tokens = x.shape[1]
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("pos", "embed")
+            ),
+            (n_tokens, self.embed_dim),
+        )
+        x = x + pos[None]
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = constrain(x, "batch", "seq", "embed")
+        x = TransformerStack(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.embed_dim // self.num_heads,
+            mlp_dim=self.mlp_ratio * self.embed_dim,
+            pre_ln=True,
+            causal=False,
+            activation="gelu_exact",
+            ln_eps=1e-12,
+            dropout_rate=self.dropout_rate,
+            remat=self.remat,
+            dtype=self.dtype,
+            name="encoder",
+        )(x, None, not train)
+        x = layer_norm(1e-12, self.dtype, "ln_f")(x)
+        x = x[:, 0]  # CLS token
+        logits = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed", "vocab")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("vocab",)
+            ),
+            name="head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+@register("vit")
+def vit(size: str = "l16", **kwargs):
+    sizes = {
+        # (layers, heads, embed, patch)
+        "tiny": (2, 4, 64, 8),
+        "b16": (12, 12, 768, 16),
+        "l16": (24, 16, 1024, 16),
+    }
+    n_l, n_h, d, p = sizes[size]
+    defaults = dict(num_layers=n_l, num_heads=n_h, embed_dim=d, patch_size=p)
+    defaults.update(kwargs)
+    return ViT(**defaults)

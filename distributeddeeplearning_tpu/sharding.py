@@ -45,6 +45,7 @@ DEFAULT_LOGICAL_RULES: tuple[tuple[str, str | tuple[str, ...] | None], ...] = (
     ("vocab", "tp"),
     ("expert", "ep"),
     ("stage", "pp"),
+    ("pos", None),
     ("conv_h", None),
     ("conv_w", None),
     ("conv_in", None),

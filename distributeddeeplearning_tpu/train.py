@@ -91,8 +91,25 @@ def lm_task() -> Task:
     return Task(input_fn=input_fn, loss_fn=loss_fn)
 
 
+def mlm_task() -> Task:
+    """Masked LM: loss only on masked positions (labels == -1 is ignored)."""
+
+    def loss_fn(logits, batch):
+        labels = batch["labels"]
+        weights = (labels >= 0).astype(jnp.float32)
+        per_tok = _xent(logits, jnp.maximum(labels, 0)) * weights
+        loss = per_tok.sum() / jnp.maximum(weights.sum(), 1.0)
+        return loss, {"loss": loss, "masked_fraction": weights.mean()}
+
+    return Task(input_fn=lambda b: (b["input_tokens"],), loss_fn=loss_fn)
+
+
 def get_task(name: str) -> Task:
-    return {"classification": classification_task, "lm": lm_task}[name]()
+    return {
+        "classification": classification_task,
+        "lm": lm_task,
+        "mlm": mlm_task,
+    }[name]()
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent compilation cache: the suite is compile-dominated (every parity
+# test recompiles ResNet/transformer steps), so cache across runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/ddl_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import pytest  # noqa: E402
 
 from distributeddeeplearning_tpu.mesh import (  # noqa: E402

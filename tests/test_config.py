@@ -53,6 +53,25 @@ def test_override_dict_kwargs():
     assert cfg.model.kwargs == {"width": 8}
 
 
+def test_dataset_kwargs_cover_every_kind():
+    # Regression: a dataset kind accepted by make_dataset but unhandled in
+    # dataset_kwargs silently dropped vocab_size/seq_len overrides (NaN bug).
+    # Iterates the registry so new kinds are covered automatically.
+    import dataclasses
+
+    from distributeddeeplearning_tpu import data as data_lib
+
+    for kind in data_lib.DATASET_KINDS:
+        cfg = dataclasses.replace(
+            Config().data, kind=kind, vocab_size=512, batch_size=4
+        )
+        ds = data_lib.make_dataset(kind, **cfg.dataset_kwargs())
+        assert ds.batch_size == 4
+        if hasattr(ds, "vocab_size"):
+            assert ds.vocab_size == 512
+        ds.batch(0)  # constructible and indexable
+
+
 def test_config_json_roundtrippable():
     import json
 
