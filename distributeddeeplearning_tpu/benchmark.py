@@ -1,0 +1,97 @@
+"""Benchmark harness — measures the north-star metric
+(``BASELINE.json:2``: "ResNet-50 ImageNet images/sec/chip") and the per-config
+throughput table in ``BASELINE.md``.
+
+The reference publishes no numbers (``BASELINE.json:13`` ``published: {}``),
+so this harness *establishes* the baseline: round-1 measured values are
+persisted in ``BENCH_BASELINE.json`` at the repo root and later rounds report
+``vs_baseline`` against them (>1.0 = faster than round 1).
+
+Methodology: synthetic (host-generated, deterministic) data so input IO never
+gates the measurement; ``warmup`` steps to absorb compilation + autotuning;
+then ``steps`` timed steps bounded by ``jax.block_until_ready`` on the final
+state; throughput = items * steps / elapsed / device_count. A recompilation
+inside the timed window would poison the number, so we assert the step cache
+doesn't grow after warmup.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+
+import jax
+
+from . import data as data_lib
+from .config import Config
+from .utils.pytree import tree_size
+
+
+def run_benchmark(
+    cfg: Config, *, warmup: int = 5, steps: int = 30
+) -> dict:
+    """Time ``steps`` train steps of the config's workload. Returns the
+    one-line JSON record the driver contract expects."""
+    from .cli import build_all
+
+    mesh, _, trainer, dataset = build_all(cfg)
+    state = trainer.init(cfg.train.seed, dataset.batch(0))
+    n_params = tree_size(state.params)
+
+    batches = data_lib.prefetch(
+        data_lib.sharded_batches(dataset.iter_from(0), mesh), size=2
+    )
+    step = trainer.train_step
+    for _ in range(warmup):
+        state, metrics = step(state, next(batches))
+    jax.block_until_ready(state)
+    compiles_after_warmup = step._cache_size()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, next(batches))
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    if step._cache_size() != compiles_after_warmup:
+        raise RuntimeError(
+            "train_step recompiled inside the timed window — benchmark invalid"
+        )
+
+    # items/step: images for vision tasks, tokens for LM/MLM tasks.
+    b0 = dataset.batch(0)
+    if "image" in b0:
+        items, unit = b0["image"].shape[0], "images/sec/chip"
+    else:
+        key = "tokens" if "tokens" in b0 else "input_tokens"
+        items, unit = b0[key].shape[0] * b0[key].shape[1], "tokens/sec/chip"
+
+    per_chip = items * steps / elapsed / jax.device_count()
+    return {
+        "metric": f"{cfg.model.name}_{cfg.train.task}_throughput",
+        "value": round(per_chip, 2),
+        "unit": unit,
+        "steps_per_sec": round(steps / elapsed, 4),
+        "params": n_params,
+        "device_count": jax.device_count(),
+        "platform": jax.default_backend(),
+        "loss": float(metrics["loss"]),
+    }
+
+
+def vs_baseline(metric: str, value: float, repo_root: str | None = None) -> float:
+    """Ratio vs the persisted round-1 measurement (1.0 on first measurement;
+    the baseline file is committed so later rounds show the trend)."""
+    root = pathlib.Path(repo_root or pathlib.Path(__file__).resolve().parent.parent)
+    path = root / "BENCH_BASELINE.json"
+    table = {}
+    if path.exists():
+        table = json.loads(path.read_text())
+    if metric not in table:
+        table[metric] = value
+        try:
+            path.write_text(json.dumps(table, indent=2) + "\n")
+        except OSError:
+            pass  # read-only checkout: still report vs current value
+    return round(value / table[metric], 4)

@@ -1,0 +1,21 @@
+"""FSDP / ZeRO-3-style parameter sharding — also purely a placement decision.
+
+Parameters' ``embed`` dimension is mapped to the ``fsdp`` mesh axis by
+``DEFAULT_LOGICAL_RULES``; the batch is sharded over ``('dp','fsdp')`` jointly,
+so the ``fsdp`` axis acts as data parallelism whose parameter storage is
+sharded. XLA's SPMD partitioner then emits, per layer, the all-gather of that
+layer's params before use and the reduce-scatter of its grads after — the
+ZeRO-3 communication schedule — without any gather/scatter code here. The
+latency-hiding scheduler overlaps those collectives with compute.
+
+There is no rules preset to apply: FSDP **is** ``DEFAULT_LOGICAL_RULES`` with
+``fsdp > 1`` in the mesh. In particular the embedding table (usually the
+largest parameter) is already sharded on BOTH its dims under the defaults —
+vocab over ``tp`` and embed over ``fsdp`` — so no extra vocab rule is needed.
+(A rule like ``vocab=('tp','fsdp')`` would actually *lose* the tp sharding:
+flax drops a composite rule entirely when any of its mesh axes is already
+taken by another dim of the same array.)
+
+ZeRO-1 (optimizer-state-only sharding, reference workload 4) lives in
+``zero.py``; combining ``fsdp>1`` with ``zero1=True`` shards *everything*.
+"""

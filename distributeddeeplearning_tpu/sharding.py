@@ -91,7 +91,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def constrain(x, *logical_axes, rules=DEFAULT_LOGICAL_RULES):
+def constrain(x, *logical_axes, rules=None):
     """Constrain an activation's sharding by logical axis names (no-op outside
-    a mesh context). Used inside model code between blocks."""
+    a mesh context). Used inside model code between blocks.
+
+    Rules resolution: an ambient ``nn.logical_axis_rules(...)`` context (the
+    Trainer installs its own rules around every model call) takes precedence;
+    otherwise ``DEFAULT_LOGICAL_RULES``. This is what lets a rules preset like
+    Megatron sequence parallelism reach activation constraints, not only
+    parameter shardings."""
+    if rules is None:
+        rules = nn.get_logical_axis_rules() or DEFAULT_LOGICAL_RULES
     return nn.with_logical_constraint(x, P(*logical_axes), rules=rules)

@@ -201,9 +201,10 @@ class Trainer:
 
     def _init_fn(self, rng, example_inputs):
         p_rng, d_rng, s_rng = jax.random.split(rng, 3)
-        variables = self.model.init(
-            {"params": p_rng, "dropout": d_rng}, *example_inputs, train=False
-        )
+        with nn.logical_axis_rules(self.rules):
+            variables = self.model.init(
+                {"params": p_rng, "dropout": d_rng}, *example_inputs, train=False
+            )
         params = variables.pop("params")
         opt_state = self.tx.init(params)
         return TrainState(
@@ -274,16 +275,17 @@ class Trainer:
         variables = {"params": params, **model_state}
         mutable = list(model_state.keys()) if train else []
         inputs = self.task.input_fn(batch)
-        if mutable:
-            out, updates = self.model.apply(
-                variables, *inputs, train=train, mutable=mutable,
-                rngs={"dropout": rng},
-            )
-        else:
-            out = self.model.apply(
-                variables, *inputs, train=train, rngs={"dropout": rng}
-            )
-            updates = model_state
+        with nn.logical_axis_rules(self.rules):
+            if mutable:
+                out, updates = self.model.apply(
+                    variables, *inputs, train=train, mutable=mutable,
+                    rngs={"dropout": rng},
+                )
+            else:
+                out = self.model.apply(
+                    variables, *inputs, train=train, rngs={"dropout": rng}
+                )
+                updates = model_state
         loss, metrics = self.task.loss_fn(out, batch)
         return loss, (metrics, updates)
 
