@@ -65,7 +65,9 @@ def run_benchmark(
         items, unit = b0["image"].shape[0], "images/sec/chip"
     else:
         key = "tokens" if "tokens" in b0 else "input_tokens"
-        items, unit = b0[key].shape[0] * b0[key].shape[1], "tokens/sec/chip"
+        # Causal-LM batches carry seq_len+1 tokens; the model trains on L.
+        length = b0[key].shape[1] - (1 if key == "tokens" else 0)
+        items, unit = b0[key].shape[0] * length, "tokens/sec/chip"
 
     per_chip = items * steps / elapsed / jax.device_count()
     return {
@@ -80,18 +82,23 @@ def run_benchmark(
     }
 
 
-def vs_baseline(metric: str, value: float, repo_root: str | None = None) -> float:
-    """Ratio vs the persisted round-1 measurement (1.0 on first measurement;
-    the baseline file is committed so later rounds show the trend)."""
+def vs_baseline(
+    metric: str, value: float, repo_root: str | None = None, record: bool = False
+) -> float:
+    """Ratio vs the committed round-1 measurement in ``BENCH_BASELINE.json``.
+
+    Read-only unless ``record=True`` (used once, deliberately, to establish a
+    baseline that is then reviewed and committed — a benchmark run must not
+    dirty the checkout as a side effect). Unknown metric without ``record``
+    reports 1.0."""
     root = pathlib.Path(repo_root or pathlib.Path(__file__).resolve().parent.parent)
     path = root / "BENCH_BASELINE.json"
     table = {}
     if path.exists():
         table = json.loads(path.read_text())
     if metric not in table:
+        if not record:
+            return 1.0
         table[metric] = value
-        try:
-            path.write_text(json.dumps(table, indent=2) + "\n")
-        except OSError:
-            pass  # read-only checkout: still report vs current value
+        path.write_text(json.dumps(table, indent=2) + "\n")
     return round(value / table[metric], 4)

@@ -81,9 +81,10 @@ class SyntheticImages(IndexedDataset):
 class SyntheticTokens(IndexedDataset):
     """Deterministic random token sequences for causal-LM workloads.
 
-    Yields ``{'tokens': [B, L] int32}``; the LM task derives inputs/targets
-    by causal shift on device. (MLM uses :class:`SyntheticMLM`, which masks
-    host-side.)
+    Yields ``{'tokens': [B, seq_len+1] int32}`` — one extra token so the LM
+    task's causal shift (inputs ``[:-1]``, targets ``[1:]``) trains on exactly
+    ``seq_len`` positions. This keeps the *model* sequence length equal to the
+    configured one, which context parallelism relies on (seq % cp == 0).
     """
 
     batch_size: int
@@ -98,7 +99,10 @@ class SyntheticTokens(IndexedDataset):
         rng = np.random.default_rng((self.seed << 20) + index)
         return {
             "tokens": rng.integers(
-                0, self.vocab_size, (self.batch_size, self.seq_len), dtype=np.int32
+                0,
+                self.vocab_size,
+                (self.batch_size, self.seq_len + 1),
+                dtype=np.int32,
             )
         }
 

@@ -30,6 +30,8 @@ class GPT2(nn.Module):
     dropout_rate: float = 0.0
     remat: str = "none"
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"  # xla | ulysses | ring (see models/transformer.py)
+    mesh: object = None  # required for attn_impl='ring'
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -70,6 +72,8 @@ class GPT2(nn.Module):
             dropout_rate=self.dropout_rate,
             remat=self.remat,
             dtype=self.dtype,
+            attn_impl=self.attn_impl,
+            mesh=self.mesh,
             name="h",
         )(x, None, not train)
         x = layer_norm(1e-5, self.dtype, "ln_f")(x)

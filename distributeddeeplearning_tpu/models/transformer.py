@@ -41,7 +41,17 @@ def dense_init(scale: float = 0.02):
 
 
 class SelfAttention(nn.Module):
-    """Multi-head self-attention with logical-axis-annotated projections."""
+    """Multi-head self-attention with logical-axis-annotated projections.
+
+    ``attn_impl`` selects the attention core (SURVEY.md §2b/§5):
+    - ``xla``:     einsum + softmax, fused by XLA (default);
+    - ``ulysses``: same core, but q/k/v are constrained to the
+                   seq-gathered/head-sharded layout so the partitioner emits
+                   the Ulysses all-to-alls around it (``cp`` mesh axis);
+    - ``ring``:    explicit shard_map ring attention over ``cp`` with
+                   ppermute KV rotation (``ops/ring_attention.py``); needs
+                   ``mesh`` and supports mask=None, dropout=0 only.
+    """
 
     num_heads: int
     head_dim: int
@@ -49,6 +59,8 @@ class SelfAttention(nn.Module):
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.float32
     init_scale: float = 0.02
+    attn_impl: str = "xla"  # xla | ulysses | ring
+    mesh: object = None  # jax.sharding.Mesh, required for attn_impl='ring'
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -68,21 +80,54 @@ class SelfAttention(nn.Module):
         k = proj("key")(x)
         v = proj("value")(x)
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        scores = scores / np.sqrt(self.head_dim)
-        if self.causal:
-            q_len, k_len = scores.shape[-2], scores.shape[-1]
-            causal_mask = jnp.tril(jnp.ones((q_len, k_len), bool))
-            scores = jnp.where(causal_mask[None, None], scores, -1e30)
-        if mask is not None:
-            # mask: [batch, k_len] (1 = attend) or broadcastable to scores.
-            if mask.ndim == 2:
-                mask = mask[:, None, None, :]
-            scores = jnp.where(mask.astype(bool), scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-        probs = nn.Dropout(self.dropout_rate, deterministic=deterministic)(probs)
+        if self.attn_impl == "ring":
+            if mask is not None or (self.dropout_rate and not deterministic):
+                raise NotImplementedError(
+                    "ring attention supports mask=None and no active "
+                    "attention-dropout"
+                )
+            if self.mesh is None:
+                raise ValueError("attn_impl='ring' requires mesh")
+            from ..ops import ring_attention
 
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            out = ring_attention(q, k, v, self.mesh, causal=self.causal)
+        else:
+            if self.attn_impl == "ulysses":
+                if self.mesh is not None:
+                    from ..parallel.sp_ulysses import check_ulysses_shapes
+
+                    check_ulysses_shapes(
+                        self.num_heads,
+                        q.shape[1],
+                        self.mesh.shape["tp"],
+                        self.mesh.shape["cp"],
+                    )
+                # Reshard seq->heads for the attention core; the inverse
+                # constraint below restores the seq-sharded layout.
+                reshard = lambda t: constrain(  # noqa: E731
+                    t, "batch", "seq_attn", "heads_attn", "kv"
+                )
+                q, k, v = reshard(q), reshard(k), reshard(v)
+            elif self.attn_impl != "xla":
+                raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            scores = scores / np.sqrt(self.head_dim)
+            if self.causal:
+                q_len, k_len = scores.shape[-2], scores.shape[-1]
+                causal_mask = jnp.tril(jnp.ones((q_len, k_len), bool))
+                scores = jnp.where(causal_mask[None, None], scores, -1e30)
+            if mask is not None:
+                # mask: [batch, k_len] (1 = attend) or broadcastable to scores.
+                if mask.ndim == 2:
+                    mask = mask[:, None, None, :]
+                scores = jnp.where(mask.astype(bool), scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+            probs = nn.Dropout(self.dropout_rate, deterministic=deterministic)(
+                probs
+            )
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            if self.attn_impl == "ulysses":
+                out = constrain(out, "batch", "seq", "heads", "kv")
         out = nn.DenseGeneral(
             features=features,
             axis=(-2, -1),
@@ -154,6 +199,8 @@ class TransformerBlock(nn.Module):
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.float32
     init_scale: float = 0.02
+    attn_impl: str = "xla"
+    mesh: object = None
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -164,6 +211,8 @@ class TransformerBlock(nn.Module):
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
             init_scale=self.init_scale,
+            attn_impl=self.attn_impl,
+            mesh=self.mesh,
             name="attn",
         )
         mlp = Mlp(
@@ -202,6 +251,8 @@ class TransformerStack(nn.Module):
     remat: str = "none"
     dtype: Dtype = jnp.float32
     init_scale: float = 0.02
+    attn_impl: str = "xla"
+    mesh: object = None
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -226,6 +277,8 @@ class TransformerStack(nn.Module):
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
                 init_scale=self.init_scale,
+                attn_impl=self.attn_impl,
+                mesh=self.mesh,
                 name=f"block_{i}",
             )(x, mask, deterministic)
         return x

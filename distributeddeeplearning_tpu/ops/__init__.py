@@ -1,0 +1,9 @@
+"""Kernel/op layer: attention implementations and (later) Pallas kernels.
+
+The reference's "CUDA forward/backward kernels" (``BASELINE.json:5``) map here:
+the default implementation is XLA-fused HLO (jit + autodiff); long-context
+variants (ring attention) are explicit shard_map programs; Pallas Mosaic
+kernels provide fused alternatives for the hot ops on real TPU.
+"""
+
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,23 @@
+"""Ring attention / context parallelism strategy notes (SURVEY §2b, §5).
+
+Mechanism lives in ``ops/ring_attention.py`` (shard_map + ppermute KV ring +
+online softmax) and is selected per-model with
+``model(..., attn_impl='ring', mesh=mesh)`` on a mesh with ``cp > 1``.
+
+Memory: per device O(seq/cp) activations and one rotating KV block — context
+length scales linearly with the cp ring size, which is the point. Comms: cp-1
+KV-block ppermutes per attention, each a single-neighbor ICI hop, overlapped
+with block compute by the XLA scheduler (and fully fused in the Pallas
+variant, ops/ M5).
+
+Composes with DP/FSDP (batch axes) and TP (heads axis) because the shard_map
+in/out specs carry all of them. Requires seq % cp == 0, mask=None, and
+attention-dropout 0 (matmul/residual dropout unaffected).
+"""
+
+from __future__ import annotations
+
+
+def check_ring_shapes(seq_len: int, cp: int) -> None:
+    if seq_len % cp:
+        raise ValueError(f"ring: seq_len={seq_len} not divisible by cp={cp}")

@@ -1,0 +1,34 @@
+"""Ulysses sequence parallelism — all-to-all seq<->heads reshard (SURVEY §2b).
+
+Outside attention, activations live seq-sharded: [batch, seq/cp, embed].
+Attention needs every query to see every key, so for the attention core the
+layout flips to head-sharded/seq-gathered: [batch, seq, heads/(tp*cp), kv].
+The flip each way is an all-to-all over the ``cp`` axis — on TPU this is not
+hand-written comms: ``SelfAttention(attn_impl='ulysses')`` constrains q/k/v to
+the logical axes ('batch','seq_attn','heads_attn','kv') where the rules table
+maps ``heads_attn -> ('tp','cp')`` and ``seq_attn -> None``; the XLA SPMD
+partitioner lowers the layout change to all-to-alls over ICI and overlaps
+them with the projections.
+
+Constraints vs the reference pattern: DeepSpeed-Ulysses posts
+``all_to_all_single`` on NCCL around an unchanged attention; here the
+*constraint* is the program and the compiler owns scheduling.
+
+Requirements: num_heads % (tp*cp) == 0 and seq % cp == 0. Composes with
+DP/FSDP (batch axes untouched) and TP (heads already tp-sharded; cp divides
+the remaining head groups). Unlike ring attention it keeps full O(seq^2)
+score blocks per device, so ring (``sp_ring.py``) wins at extreme context
+lengths; Ulysses wins when heads are plentiful and seq is moderate.
+"""
+
+from __future__ import annotations
+
+
+def check_ulysses_shapes(num_heads: int, seq_len: int, tp: int, cp: int) -> None:
+    """Validate divisibility before tracing (clearer than an XLA error)."""
+    if num_heads % (tp * cp):
+        raise ValueError(
+            f"ulysses: num_heads={num_heads} not divisible by tp*cp={tp * cp}"
+        )
+    if seq_len % cp:
+        raise ValueError(f"ulysses: seq_len={seq_len} not divisible by cp={cp}")

@@ -46,6 +46,11 @@ DEFAULT_LOGICAL_RULES: tuple[tuple[str, str | tuple[str, ...] | None], ...] = (
     ("expert", "ep"),
     ("stage", "pp"),
     ("pos", None),
+    # Inside-attention layout for Ulysses sequence parallelism: heads pick up
+    # the cp axis (on top of tp) while seq is gathered; constraining q/k/v to
+    # these makes the SPMD partitioner emit the seq<->heads all-to-alls.
+    ("seq_attn", None),
+    ("heads_attn", ("tp", "cp")),
     ("conv_h", None),
     ("conv_w", None),
     ("conv_in", None),

@@ -1,0 +1,60 @@
+"""Shared test helpers: mesh construction over device subsets and the
+tiny-GPT-2 parity train loop every parallelism-strategy test reuses
+(SURVEY §4 tier 2 — the single template, not per-file copies)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+
+from distributeddeeplearning_tpu import data as data_lib
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.mesh import MeshConfig, build_mesh
+from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
+
+
+def mesh_of(**axes):
+    """Mesh over exactly prod(axes) of the simulated devices — lets a test
+    exercise e.g. a pure tp=2 mesh without padding dp to absorb the rest."""
+    n = math.prod(axes.values())
+    axes.setdefault("dp", 1)
+    return build_mesh(MeshConfig(**axes), devices=jax.devices()[:n])
+
+
+def train_tiny_gpt2(
+    mesh,
+    *,
+    attn_impl: str = "xla",
+    rules=None,
+    n_steps: int = 5,
+    batch_size: int = 16,
+    seq_len: int = 32,
+    **trainer_kw,
+):
+    """Train the tiny GPT-2 for ``n_steps`` on synthetic tokens; returns
+    (per-step losses, final TrainState). Deterministic in everything except
+    the mesh/sharding, which is what parity tests compare across."""
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=256, max_len=64, dropout_rate=0.0,
+        attn_impl=attn_impl, mesh=mesh if attn_impl == "ring" else None,
+    )
+    ds = data_lib.SyntheticTokens(
+        batch_size=batch_size, seq_len=seq_len, vocab_size=256, seed=0,
+        n_distinct=4,
+    )
+    kw = dict(donate=False)
+    if rules is not None:
+        kw["rules"] = rules
+    kw.update(trainer_kw)
+    trainer = Trainer(
+        model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh, **kw
+    )
+    state = trainer.init(0, ds.batch(0))
+    losses = []
+    for i, batch in enumerate(data_lib.sharded_batches(ds, mesh)):
+        if i >= n_steps:
+            break
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses, state

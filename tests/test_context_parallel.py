@@ -1,0 +1,110 @@
+"""M4b/M4c: context parallelism — ring attention + Ulysses parity.
+
+Tier-2 harness (SURVEY §4): cp-sharded execution must match the unsharded
+xla attention bit-for-tolerance, both at the op level (forward + gradients)
+and end-to-end (tiny GPT-2 trained for N steps).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearning_tpu.mesh import single_device_mesh
+from distributeddeeplearning_tpu.ops import ring_attention
+
+from helpers import mesh_of, train_tiny_gpt2
+
+RTOL, ATOL = 2e-4, 2e-5
+
+
+# -- op-level: ring vs plain softmax attention ------------------------------
+
+
+def reference_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+
+
+def make_qkv(b=2, l=32, h=4, d=8, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, l, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+def test_ring_forward_matches_reference_causal_and_full():
+    q, k, v = make_qkv()
+    mesh = mesh_of(cp=4)
+    for causal in (True, False):
+        ref = reference_attention(q, k, v, causal)
+        out = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh, causal=causal)
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_ring_gradients_match_reference():
+    q, k, v = make_qkv()
+    mesh = mesh_of(cp=4)
+
+    def loss_ring(q, k, v):
+        return (ring_attention(q, k, v, mesh, causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, True) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_composes_with_dp_and_tp():
+    # dp=2, tp=2, cp=2: the shard_map specs carry all three axes.
+    q, k, v = make_qkv(b=4, l=16, h=4, d=8)
+    mesh = mesh_of(dp=2, tp=2, cp=2)
+    ref = reference_attention(q, k, v, True)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=True))(
+        q, k, v
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+# -- end-to-end: tiny GPT-2 under cp sharding -------------------------------
+
+
+def run_gpt2(mesh, attn_impl="xla", n_steps=5):
+    losses, _ = train_tiny_gpt2(mesh, attn_impl=attn_impl, n_steps=n_steps)
+    return losses
+
+
+def test_gpt2_ring_cp4_parity():
+    l1 = run_gpt2(single_device_mesh())
+    lr = run_gpt2(mesh_of(cp=4), attn_impl="ring")
+    np.testing.assert_allclose(l1, lr, rtol=RTOL, atol=ATOL)
+
+
+def test_gpt2_ulysses_cp4_parity():
+    l1 = run_gpt2(single_device_mesh())
+    lu = run_gpt2(mesh_of(cp=4), attn_impl="ulysses")
+    np.testing.assert_allclose(l1, lu, rtol=RTOL, atol=ATOL)
+
+
+def test_gpt2_ring_composed_dp2_cp2_parity():
+    l1 = run_gpt2(single_device_mesh())
+    lr = run_gpt2(mesh_of(dp=2, cp=2), attn_impl="ring")
+    np.testing.assert_allclose(l1, lr, rtol=RTOL, atol=ATOL)
+
+
+def test_ulysses_shape_validation():
+    import pytest
+
+    from distributeddeeplearning_tpu.parallel.sp_ulysses import check_ulysses_shapes
+
+    check_ulysses_shapes(num_heads=8, seq_len=32, tp=2, cp=4)
+    with pytest.raises(ValueError):
+        check_ulysses_shapes(num_heads=6, seq_len=32, tp=2, cp=4)

@@ -7,51 +7,14 @@ params, so each test also asserts the params are *actually* sharded via
 ``sharded_fraction``.
 """
 
-import jax
 import numpy as np
 
-from distributeddeeplearning_tpu import data as data_lib
-from distributeddeeplearning_tpu import models
-from distributeddeeplearning_tpu.mesh import MeshConfig, build_mesh, single_device_mesh
+from distributeddeeplearning_tpu.mesh import single_device_mesh
 from distributeddeeplearning_tpu.parallel.tp import per_device_bytes, sharded_fraction
-from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
 
-N_STEPS = 5
+from helpers import mesh_of, train_tiny_gpt2 as run_gpt2
+
 RTOL, ATOL = 2e-4, 2e-5
-
-
-def mesh_of(**axes):
-    """Mesh over exactly prod(axes) of the 8 simulated devices — lets a test
-    exercise e.g. a pure tp=2 mesh without padding dp to absorb the rest."""
-    import math
-
-    n = math.prod(axes.values())
-    axes.setdefault("dp", 1)
-    return build_mesh(MeshConfig(**axes), devices=jax.devices()[:n])
-
-
-def run_gpt2(mesh, rules=None, n_steps=N_STEPS, **trainer_kw):
-    model = models.get_model(
-        "gpt2", size="tiny", vocab_size=256, max_len=64, dropout_rate=0.0
-    )
-    ds = data_lib.SyntheticTokens(
-        batch_size=16, seq_len=32, vocab_size=256, seed=0, n_distinct=4
-    )
-    kw = dict(donate=False)
-    if rules is not None:
-        kw["rules"] = rules
-    kw.update(trainer_kw)
-    trainer = Trainer(
-        model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh, **kw
-    )
-    state = trainer.init(0, ds.batch(0))
-    losses = []
-    for i, batch in enumerate(data_lib.sharded_batches(ds, mesh)):
-        if i >= n_steps:
-            break
-        state, metrics = trainer.train_step(state, batch)
-        losses.append(float(metrics["loss"]))
-    return losses, state
 
 
 def test_tp2_parity_and_actually_sharded():
