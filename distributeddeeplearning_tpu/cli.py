@@ -25,9 +25,21 @@ from .utils.pytree import tree_size
 def build_all(cfg: Config):
     """Construct (mesh, model, trainer, dataset) from a config."""
     mesh = build_mesh(cfg.mesh)
-    model = models.get_model(
-        cfg.model.name, remat=cfg.train.remat, **cfg.model.kwargs
-    )
+    model = models.get_model(cfg.model.name, **cfg.model.kwargs)
+    # Mesh-aware models (ring/Ulysses attention, pipelined stacks) need the
+    # live mesh; a config that asked for those features but got no mesh would
+    # otherwise silently fall back or fail at first call.
+    updates = {}
+    if hasattr(model, "mesh") and model.mesh is None:
+        updates["mesh"] = mesh
+    if cfg.train.remat != "none":
+        if not hasattr(model, "remat"):
+            raise ValueError(
+                f"model {cfg.model.name!r} does not support remat"
+            )
+        updates["remat"] = cfg.train.remat
+    if updates:
+        model = model.clone(**updates)
     tx = make_optimizer(
         cfg.optim.name,
         cfg.optim.lr,

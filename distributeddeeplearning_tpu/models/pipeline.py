@@ -1,0 +1,222 @@
+"""Pipeline-parallel transformer models (GPipe over the ``pp`` mesh axis).
+
+The reference-style pipeline puts each stage in its own process; here stage
+parameters are ONE stacked pytree (leading ``stage`` logical axis -> ``pp``
+mesh axis) and execution is the SPMD GPipe loop in ``parallel/pp.py``.
+
+``pipeline=False`` (or a pp=1 mesh) runs the *same* stacked parameters
+sequentially — identical math, identical init RNG stream — which is the
+parity oracle the pipeline tests compare against.
+
+Embeddings / final LN / LM head live outside the pipeline and are computed
+replicated over ``pp`` (batch is not sharded on ``pp``, so this is redundant
+compute, not extra comms — the standard v1 trade; splitting them into the
+first/last stages is a later optimization).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from ..parallel.pp import check_pipeline_shapes, gpipe, sequential, stack_stage_axis
+from ..sharding import constrain
+from .transformer import TransformerBlock, layer_norm
+
+
+class PipelineStage(nn.Module):
+    """``layers_per_stage`` transformer blocks, constraint-free (the stage
+    body runs inside shard_map where global sharding constraints don't
+    apply)."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    pre_ln: bool = True
+    causal: bool = False
+    activation: str = "gelu_exact"
+    ln_eps: float = 1e-5
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                self.num_heads,
+                self.head_dim,
+                self.mlp_dim,
+                pre_ln=self.pre_ln,
+                causal=self.causal,
+                activation=self.activation,
+                ln_eps=self.ln_eps,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                constrain_out=False,
+                name=f"block_{i}",
+            )(x, None, deterministic)
+        return x
+
+
+class PipelinedTransformerStack(nn.Module):
+    """Drop-in for ``TransformerStack`` with stage-stacked parameters.
+
+    Parameters live under one ``stages`` entry with leaves ``[S, ...]``; the
+    leading dim carries the ``stage`` logical axis so the rules table shards
+    it over ``pp``.
+    """
+
+    num_layers: int
+    num_stages: int
+    num_microbatches: int
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    pre_ln: bool = True
+    causal: bool = False
+    activation: str = "gelu_exact"
+    ln_eps: float = 1e-5
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    pipeline: bool = True
+    mesh: object = None  # jax.sharding.Mesh, required when pipelining
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        if mask is not None:
+            raise NotImplementedError("pipelined stack supports mask=None only")
+        if self.dropout_rate and not deterministic:
+            raise NotImplementedError(
+                "dropout inside pipeline stages is not supported (set "
+                "dropout_rate=0 or deterministic=True)"
+            )
+        use_pipeline = (
+            self.pipeline and self.mesh is not None and self.mesh.shape["pp"] > 1
+        )
+        # The GPipe body microbatches the per-device batch shard, so validate
+        # the local (post dp/fsdp split) size, not the global one.
+        local_batch = x.shape[0]
+        if use_pipeline:
+            local_batch //= self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        check_pipeline_shapes(
+            local_batch, self.num_microbatches, self.num_layers, self.num_stages
+        )
+        stage_mod = PipelineStage(
+            self.num_layers // self.num_stages,
+            self.num_heads,
+            self.head_dim,
+            self.mlp_dim,
+            pre_ln=self.pre_ln,
+            causal=self.causal,
+            activation=self.activation,
+            ln_eps=self.ln_eps,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+        )
+        dummy = jnp.zeros((1,) + x.shape[1:], x.dtype)
+
+        def init_stacked(rng):
+            rngs = jax.random.split(rng, self.num_stages)
+            params = jax.vmap(lambda r: stage_mod.init(r, dummy)["params"])(rngs)
+            return stack_stage_axis(params)
+
+        stacked = self.param("stages", init_stacked)
+
+        def stage_fn(stage_params, y):
+            # Clear the ambient logical-axis rules: inside shard_map arrays
+            # are per-device (manual) and flax's param-unbox constraint (which
+            # resolves against the rules) must become a no-op.
+            with nn.logical_axis_rules(()):
+                return stage_mod.apply({"params": stage_params}, y, deterministic)
+
+        if use_pipeline:
+            if self.mesh.shape["pp"] != self.num_stages:
+                raise ValueError(
+                    f"mesh pp={self.mesh.shape['pp']} != "
+                    f"num_stages={self.num_stages}"
+                )
+            return gpipe(
+                stage_fn,
+                stacked,
+                x,
+                mesh=self.mesh,
+                num_microbatches=self.num_microbatches,
+            )
+        return sequential(stage_fn, stacked, x)
+
+
+class PipelinedGPT2(nn.Module):
+    """GPT-2 with a pipelined block stack — the PP testbed model (same
+    embeddings / tied head as ``models/gpt2.py``)."""
+
+    vocab_size: int = 50257
+    max_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    num_stages: int = 2
+    num_microbatches: int = 2
+    pipeline: bool = True
+    dtype: jnp.dtype = jnp.float32
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, L = tokens.shape
+        if L > self.max_len:
+            raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
+        wte = nn.Embed(
+            self.vocab_size,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="wte",
+        )
+        wpe = nn.Embed(
+            self.max_len,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.01), ("pos", "embed")
+            ),
+            name="wpe",
+        )
+        x = wte(tokens) + wpe(jnp.arange(L)[None, :])
+        x = constrain(x, "batch", "seq", "embed")
+        x = PipelinedTransformerStack(
+            num_layers=self.num_layers,
+            num_stages=self.num_stages,
+            num_microbatches=self.num_microbatches,
+            num_heads=self.num_heads,
+            head_dim=self.embed_dim // self.num_heads,
+            mlp_dim=4 * self.embed_dim,
+            pre_ln=True,
+            causal=True,
+            activation="gelu_tanh",
+            ln_eps=1e-5,
+            dtype=self.dtype,
+            pipeline=self.pipeline,
+            mesh=self.mesh,
+            name="h",
+        )(x, None, not train)
+        x = layer_norm(1e-5, self.dtype, "ln_f")(x)
+        logits = wte.attend(x)
+        return logits.astype(jnp.float32)
+
+
+@register("gpt2_pp")
+def gpt2_pp(size: str = "124m", **kwargs):
+    sizes = {
+        "tiny": (4, 4, 64),
+        "124m": (12, 12, 768),
+        "350m": (24, 16, 1024),
+    }
+    n_l, n_h, d = sizes[size]
+    defaults = dict(num_layers=n_l, num_heads=n_h, embed_dim=d)
+    defaults.update(kwargs)
+    return PipelinedGPT2(**defaults)

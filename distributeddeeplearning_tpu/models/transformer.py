@@ -201,6 +201,9 @@ class TransformerBlock(nn.Module):
     init_scale: float = 0.02
     attn_impl: str = "xla"
     mesh: object = None
+    # Pipeline stages run inside an explicit shard_map where global sharding
+    # constraints are meaningless — they disable the block-boundary constraint.
+    constrain_out: bool = True
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -233,6 +236,8 @@ class TransformerBlock(nn.Module):
         else:  # BERT
             x = ln1(x + drop(attn(x, mask, deterministic)))
             x = ln2(x + mlp(x, deterministic))
+        if not self.constrain_out:
+            return x
         return constrain(x, "batch", "seq", "embed")
 
 

@@ -67,10 +67,15 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
             block_mask = jnp.where(src == idx, tril[None, None], src < idx)
         else:
             block_mask = jnp.ones((1, 1, lq, lq), bool)
-        m_new = jnp.maximum(m, jnp.where(block_mask, s, -jnp.inf).max(-1))
-        # Mask BEFORE exponentiating: a masked score far above the visible
-        # max would overflow exp to inf, and inf * 0 = NaN.
-        p = jnp.where(block_mask, jnp.exp(s - m_new[..., None]), 0.0)
+        # Mask BEFORE exponentiating — and before the subtraction, so the
+        # masked branch never materializes exp(large): exp(-inf - m) == 0
+        # exactly, and the where's transpose zeroes the masked cotangents
+        # (masking only the exp's *output* leaves an inf in the backward
+        # graph: 0 * inf = NaN grads once any masked score exceeds the
+        # visible row max by ~88).
+        s_masked = jnp.where(block_mask, s, -jnp.inf)
+        m_new = jnp.maximum(m, s_masked.max(-1))
+        p = jnp.exp(s_masked - m_new[..., None])
         rescale = jnp.exp(m - m_new)
         l = l * rescale + p.sum(-1)
         acc = acc * rescale[..., None] + jnp.einsum(

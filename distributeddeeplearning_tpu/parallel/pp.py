@@ -1,0 +1,165 @@
+"""Pipeline parallelism — GPipe schedule over the ``pp`` mesh axis.
+
+TPU-native replacement for rank-per-stage pipeline frameworks: the reference
+family of trainers places each stage in its own process and moves activations
+with NCCL send/recv; here the whole pipeline is ONE SPMD program. Stage
+parameters are stacked on a leading ``stage`` axis sharded over ``pp``; a
+``shard_map`` body runs the classic GPipe time loop as a ``lax.scan`` where
+every tick computes one stage-application per device and hands activations to
+the next stage with a single-neighbor ``lax.ppermute`` (an ICI hop). XLA's
+latency-hiding scheduler overlaps the permute with the next tick's compute.
+
+Schedule (GPipe, SURVEY.md §7 "hard parts" #1 — 1F1B is future work):
+
+- ``M`` microbatches, ``S`` stages, ``T = M + S - 1`` ticks;
+- at tick ``t`` stage ``s`` processes microbatch ``t - s`` (garbage compute
+  in the ``(S-1)/T`` bubble fraction, as in any GPipe);
+- the last stage's outputs are collected per-microbatch and broadcast to all
+  ``pp`` ranks with a masked ``psum`` so downstream (loss) code is ordinary
+  SPMD.
+
+Autodiff: ``scan`` + ``ppermute`` are differentiable; the backward pass is
+automatically the reverse pipeline (cotangents ppermute stage ``s+1 -> s``),
+i.e. GPipe's synchronous backward schedule falls out of ``jax.grad``.
+
+Composability: batch axes (``dp``/``fsdp``) pass straight through the
+``shard_map`` specs, so PP x DP works out of the box. Stage-internal tensor
+parallelism (PP x TP) would need manual collectives inside the stage body and
+is deliberately out of scope for the GPipe v1 (use TP or PP, or PP x DP).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from ..mesh import BATCH_AXES
+
+
+def check_pipeline_shapes(
+    local_batch: int, num_microbatches: int, num_layers: int, num_stages: int
+) -> None:
+    if num_layers % num_stages:
+        raise ValueError(
+            f"pipeline: num_layers={num_layers} not divisible by "
+            f"num_stages={num_stages}"
+        )
+    if local_batch % num_microbatches:
+        raise ValueError(
+            f"pipeline: per-device batch {local_batch} not divisible by "
+            f"num_microbatches={num_microbatches}"
+        )
+
+
+def _gpipe_local(stage_fn, params, x, *, axis_name: str, num_microbatches: int):
+    """Per-device GPipe time loop (runs inside shard_map).
+
+    params: this device's stage slice, leading dim 1 (squeezed here).
+    x: [local_batch, ...] — the full local batch (replicated over ``pp``).
+    Returns the last stage's outputs for every microbatch, [local_batch, ...].
+    """
+    S = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    M = num_microbatches
+    params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+    mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+    # Activation shape/dtype are stage-invariant (residual blocks), so one
+    # rotating buffer + one output accumulator suffice. x is replicated over
+    # pp but the loop makes them stage-varying — pcast the initial carries so
+    # the scan carry type is stable.
+    buf0 = jax.lax.pcast(jnp.zeros_like(mb[0]), (axis_name,), to="varying")
+    out0 = jax.lax.pcast(jnp.zeros_like(mb), (axis_name,), to="varying")
+    # Stage s -> s+1 handoff; stage 0 receives nothing (gets zeros, unused).
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        state_in, outputs = carry
+        x_in = jnp.where(stage == 0, mb[jnp.minimum(t, M - 1)], state_in)
+        y = stage_fn(params, x_in)
+        out_t = t - (S - 1)  # which microbatch the LAST stage just finished
+        outputs = jnp.where(
+            (stage == S - 1) & (out_t >= 0),
+            outputs.at[jnp.clip(out_t, 0, M - 1)].set(y),
+            outputs,
+        )
+        state_next = jax.lax.ppermute(y, axis_name, perm)
+        return (state_next, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(M + S - 1))
+    # Only the last stage holds real outputs; masked psum = broadcast to the
+    # whole pp ring so the loss is computed as ordinary SPMD code.
+    outputs = jax.lax.psum(
+        jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)), axis_name
+    )
+    return outputs.reshape(x.shape)
+
+
+def gpipe(
+    stage_fn,
+    stacked_params,
+    x,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+):
+    """Apply ``S`` stages to ``x`` as a GPipe pipeline over ``axis_name``.
+
+    stage_fn: ``(stage_params, activations) -> activations`` for ONE stage
+        (shape/dtype-preserving).
+    stacked_params: pytree with leaves ``[S, ...]`` — stage-stacked weights,
+        sharded ``P('pp')`` on the leading dim (logical axis ``stage``).
+    x: ``[global_batch, ...]`` sharded over ``BATCH_AXES``.
+
+    Returns stage_{S-1}(... stage_0(x)), sharded like ``x``.
+    """
+    S = mesh.shape[axis_name]
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    x_spec = P(BATCH_AXES)
+    if S == 1:
+        # Degenerate ring: identical math to the sequential oracle.
+        return sequential(stage_fn, stacked_params, x)
+    fn = jax.shard_map(
+        lambda p, x: _gpipe_local(
+            stage_fn, p, x, axis_name=axis_name, num_microbatches=num_microbatches
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+    )
+    return fn(stacked_params, x)
+
+
+def sequential(stage_fn, stacked_params, x):
+    """The pipeline's correctness oracle: the same stacked stages applied
+    back-to-back with a ``lax.scan`` (the idiomatic single-device execution
+    of stage-stacked weights)."""
+
+    def body(y, stage_params):
+        return stage_fn(stage_params, y), None
+
+    y, _ = jax.lax.scan(body, x, stacked_params)
+    return y
+
+
+def stack_stage_axis(params_tree):
+    """Re-box a vmapped-over-stages param tree so every leaf's leading dim
+    carries the ``stage`` logical axis (mapped to ``pp`` by the rules table).
+
+    ``jax.vmap`` over a flax ``init`` adds the stage dim to each
+    ``nn.Partitioned`` leaf's value but cannot know to extend ``names`` —
+    this fixes the metadata up.
+    """
+
+    def fix(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return leaf.replace(names=("stage",) + leaf.names)
+        return nn.Partitioned(leaf, ("stage",) + (None,) * (leaf.ndim - 1))
+
+    return jax.tree.map(
+        fix, params_tree, is_leaf=lambda l: isinstance(l, nn.Partitioned)
+    )

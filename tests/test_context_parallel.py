@@ -63,6 +63,22 @@ def test_ring_gradients_match_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
 
 
+def test_ring_gradients_finite_with_large_scores():
+    # Regression: masking only exp's *output* leaves an inf in the backward
+    # graph (0 * inf = NaN) once a masked future-block score exceeds the
+    # visible row max by ~88 — large-magnitude q/k trigger exactly that.
+    q, k, v = make_qkv()
+    q, k = q * 30.0, k * 30.0
+    mesh = mesh_of(cp=4)
+
+    def loss(q, k, v):
+        return (ring_attention(q, k, v, mesh, causal=True) ** 2).sum()
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+
+
 def test_ring_composes_with_dp_and_tp():
     # dp=2, tp=2, cp=2: the shard_map specs carry all three axes.
     q, k, v = make_qkv(b=4, l=16, h=4, d=8)

@@ -1,0 +1,169 @@
+"""Pipeline parallelism (GPipe over 'pp') — parity vs sequential execution.
+
+Tier-2 distributed-sim tests (SURVEY.md §4): the pipelined program on a
+pp>1 mesh must reproduce the sequential single-device run step for step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.data import SyntheticTokens, sharded_batches
+from distributeddeeplearning_tpu.parallel.pp import (
+    check_pipeline_shapes,
+    gpipe,
+    sequential,
+)
+from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
+
+
+def _mlp_stages(seed=0, S=4, D=16):
+    key = jax.random.PRNGKey(seed)
+    Ws = jax.random.normal(key, (S, D, D)) * 0.1
+    bs = jax.random.normal(jax.random.fold_in(key, 1), (S, D)) * 0.1
+    stage_fn = lambda p, y: jnp.tanh(y @ p[0] + p[1])  # noqa: E731
+    return stage_fn, (Ws, bs)
+
+
+class TestGpipeMechanism:
+    def test_forward_parity(self, mesh_factory):
+        mesh = mesh_factory(dp=2, pp=4)
+        stage_fn, params = _mlp_stages()
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+        y_seq = sequential(stage_fn, params, x)
+        y_pp = jax.jit(
+            lambda p, x: gpipe(stage_fn, p, x, mesh=mesh, num_microbatches=4)
+        )(params, x)
+        np.testing.assert_allclose(y_seq, y_pp, atol=1e-6)
+
+    def test_grad_parity(self, mesh_factory):
+        mesh = mesh_factory(dp=2, pp=4)
+        stage_fn, params = _mlp_stages()
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+        g_seq = jax.grad(lambda p: (sequential(stage_fn, p, x) ** 2).mean())(
+            params
+        )
+        g_pp = jax.jit(
+            jax.grad(
+                lambda p: (
+                    gpipe(stage_fn, p, x, mesh=mesh, num_microbatches=2) ** 2
+                ).mean()
+            )
+        )(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6), g_seq, g_pp
+        )
+
+    def test_pp1_mesh_runs_sequentially(self, mesh1):
+        stage_fn, params = _mlp_stages()
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+        y_seq = sequential(stage_fn, params, x)
+        y_pp = gpipe(stage_fn, params, x, mesh=mesh1, num_microbatches=2)
+        np.testing.assert_allclose(y_seq, y_pp, atol=1e-6)
+
+    def test_shape_checks(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            check_pipeline_shapes(8, 3, 4, 4)
+        with pytest.raises(ValueError, match="not divisible"):
+            check_pipeline_shapes(8, 2, 5, 4)
+
+
+def _train_losses(mesh, pipeline, steps=3, grad_accum=1, zero1=False):
+    model = models.get_model(
+        "gpt2_pp",
+        size="tiny",
+        vocab_size=64,
+        max_len=32,
+        num_stages=4,
+        num_microbatches=2,
+        pipeline=pipeline,
+        mesh=mesh if pipeline else None,
+    )
+    trainer = Trainer(
+        model,
+        make_optimizer("adamw", 1e-2),
+        get_task("lm"),
+        mesh,
+        grad_accum=grad_accum,
+        zero1=zero1,
+    )
+    ds = SyntheticTokens(batch_size=8 * grad_accum, seq_len=16, vocab_size=64)
+    state = trainer.init(0, ds.batch(0))
+    losses = []
+    for _, batch in zip(range(steps), sharded_batches(ds.iter_from(0), mesh)):
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+class TestPipelinedModelParity:
+    def test_pp4_dp2_matches_sequential(self, mesh1, mesh_factory):
+        ref = _train_losses(mesh1, pipeline=False)
+        pp = _train_losses(mesh_factory(dp=2, pp=4), pipeline=True)
+        np.testing.assert_allclose(ref, pp, rtol=2e-5)
+
+    def test_pp4_with_grad_accum_and_zero1(self, mesh1, mesh_factory):
+        ref = _train_losses(mesh1, pipeline=False, grad_accum=2)
+        pp = _train_losses(
+            mesh_factory(dp=2, pp=4), pipeline=True, grad_accum=2, zero1=True
+        )
+        np.testing.assert_allclose(ref, pp, rtol=2e-5)
+
+    def test_stage_mismatch_raises(self, mesh_factory):
+        mesh = mesh_factory(dp=4, pp=2)
+        with pytest.raises(ValueError, match="num_stages"):
+            _train_losses(mesh, pipeline=True)
+
+    def test_bad_microbatch_count_raises_clearly(self, mesh1):
+        # num_microbatches must divide the *local* batch; the check should be
+        # a clear ValueError, not a reshape-trace error inside shard_map.
+        model = models.get_model(
+            "gpt2_pp", size="tiny", vocab_size=64, max_len=32,
+            num_stages=4, num_microbatches=3, pipeline=False,
+        )
+        trainer = Trainer(
+            model, make_optimizer("adamw", 1e-2), get_task("lm"), mesh1
+        )
+        ds = SyntheticTokens(batch_size=8, seq_len=16, vocab_size=64)
+        with pytest.raises(ValueError, match="not divisible"):
+            trainer.init(0, ds.batch(0))
+
+
+def test_cli_build_forwards_mesh_to_pipelined_model(mesh_factory):
+    # Regression: a gpt2_pp config on a pp>1 mesh must actually pipeline —
+    # build_all forwards the mesh into mesh-aware models.
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import (
+        Config,
+        DataConfig,
+        MeshConfig,
+        ModelConfig,
+        OptimConfig,
+        TrainConfig,
+    )
+
+    cfg = Config(
+        model=ModelConfig(
+            name="gpt2_pp",
+            kwargs=dict(
+                size="tiny", vocab_size=64, max_len=32,
+                num_stages=4, num_microbatches=2,
+            ),
+        ),
+        data=DataConfig(
+            kind="synthetic_tokens", batch_size=8, seq_len=16, vocab_size=64
+        ),
+        optim=OptimConfig(name="adamw", lr=1e-2),
+        train=TrainConfig(task="lm", log_every=0),
+        mesh=MeshConfig(dp=2, pp=4),
+    )
+    mesh, model, trainer, dataset = build_all(cfg)
+    assert model.mesh is mesh
+    state = trainer.init(0, dataset.batch(0))
+    from distributeddeeplearning_tpu.data import sharded_batches
+
+    batch = next(iter(sharded_batches(dataset.iter_from(0), mesh)))
+    state, metrics = trainer.train_step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
