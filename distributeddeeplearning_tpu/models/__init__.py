@@ -31,4 +31,4 @@ def available() -> list[str]:
     return sorted(_REGISTRY)
 
 
-from . import bert, gpt2, pipeline, resnet, vit  # noqa: E402,F401  (register)
+from . import bert, gpt2, moe, pipeline, resnet, vit  # noqa: E402,F401

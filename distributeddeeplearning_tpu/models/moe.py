@@ -1,0 +1,258 @@
+"""Mixture-of-Experts transformer — the expert-parallelism testbed.
+
+No MoE model appears in the reference's workload list (``BASELINE.json:6-12``)
+but expert parallelism is a mandated first-class strategy (SURVEY.md §2b), so
+a GPT-2-shaped MoE variant (``gpt2_moe``) exercises it: every
+``moe_every``-th block swaps its dense MLP for a routed expert layer
+(GShard-style interleaving).
+
+Expert weights carry the ``expert`` logical axis on their leading dim; the
+rules table maps it to the ``ep`` mesh axis, and the dispatch/combine einsums
+in ``parallel/ep.py`` become XLA all-to-alls under that sharding.
+
+The router's load-balancing aux loss is surfaced through flax's ``sow`` into
+a ``losses`` collection the Trainer folds into the objective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from ..parallel.ep import expert_capacity, route_top_k
+from ..sharding import constrain
+from .transformer import (
+    SelfAttention,
+    TransformerBlock,
+    dense_init,
+    gelu_exact,
+    gelu_tanh,
+    layer_norm,
+)
+
+
+class MoeMlp(nn.Module):
+    """Routed expert MLP (drop-in for ``Mlp``).
+
+    x: [groups, tokens, embed] — each batch row is a routing group, so
+    routing decisions are independent of how the batch is sharded (the EP
+    parity-test property).
+    """
+
+    num_experts: int
+    hidden_dim: int
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    activation: str = "gelu_exact"
+    aux_loss_weight: float = 1e-2
+    dtype: jnp.dtype = jnp.float32
+    init_scale: float = 0.02
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        g, t, d = x.shape
+        e = self.num_experts
+        act = {"gelu_exact": gelu_exact, "gelu_tanh": gelu_tanh}[self.activation]
+
+        # Router runs in fp32 regardless of compute dtype (small matmul,
+        # numerically load-bearing).
+        logits = nn.Dense(
+            e,
+            dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                dense_init(self.init_scale), ("embed", "expert")
+            ),
+            use_bias=False,
+            name="router",
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        capacity = expert_capacity(
+            t, e, self.num_selected, self.capacity_factor
+        )
+        dispatch, combine, aux = route_top_k(probs, self.num_selected, capacity)
+        self.sow("losses", "moe_aux", self.aux_loss_weight * aux)
+
+        # Scatter tokens into per-expert capacity buffers: [e, g, c, d].
+        # Constraining the leading dim to 'expert' (-> ep) makes the SPMD
+        # partitioner emit the token all-to-all here.
+        expert_in = jnp.einsum("gtec,gtd->egcd", dispatch.astype(x.dtype), x)
+        expert_in = constrain(expert_in, "expert", "batch", None, "embed")
+
+        w1 = self.param(
+            "w1",
+            nn.with_logical_partitioning(
+                dense_init(self.init_scale), ("expert", "embed", "mlp")
+            ),
+            (e, d, self.hidden_dim),
+            self.dtype,
+        )
+        b1 = self.param(
+            "b1",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros, ("expert", "mlp")
+            ),
+            (e, self.hidden_dim),
+            self.dtype,
+        )
+        w2 = self.param(
+            "w2",
+            nn.with_logical_partitioning(
+                dense_init(self.init_scale), ("expert", "mlp", "embed")
+            ),
+            (e, self.hidden_dim, d),
+            self.dtype,
+        )
+        b2 = self.param(
+            "b2",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros, ("expert", "embed")
+            ),
+            (e, d),
+            self.dtype,
+        )
+        h = act(
+            jnp.einsum("egcd,edh->egch", expert_in, w1.astype(x.dtype))
+            + b1.astype(x.dtype)[:, None, None, :]
+        )
+        out = (
+            jnp.einsum("egch,ehd->egcd", h, w2.astype(x.dtype))
+            + b2.astype(x.dtype)[:, None, None, :]
+        )
+        out = constrain(out, "expert", "batch", None, "embed")
+        # Gather back to token order; dropped tokens contribute zero (the
+        # residual connection around the block carries them through).
+        return jnp.einsum("gtec,egcd->gtd", combine.astype(x.dtype), out)
+
+
+class MoeTransformerBlock(nn.Module):
+    """Pre-LN block with a routed MLP (GPT-2-shaped)."""
+
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    num_experts: int
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    causal: bool = True
+    activation: str = "gelu_tanh"
+    ln_eps: float = 1e-5
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    init_scale: float = 0.02
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        attn = SelfAttention(
+            self.num_heads,
+            self.head_dim,
+            causal=self.causal,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            init_scale=self.init_scale,
+            name="attn",
+        )
+        drop = nn.Dropout(self.dropout_rate, deterministic=deterministic)
+        x = x + drop(attn(layer_norm(self.ln_eps, self.dtype, "ln1")(x), mask,
+                          deterministic))
+        x = x + MoeMlp(
+            self.num_experts,
+            self.mlp_dim,
+            num_selected=self.num_selected,
+            capacity_factor=self.capacity_factor,
+            activation=self.activation,
+            dtype=self.dtype,
+            init_scale=self.init_scale,
+            name="moe_mlp",
+        )(layer_norm(self.ln_eps, self.dtype, "ln2")(x), deterministic)
+        return constrain(x, "batch", "seq", "embed")
+
+
+class MoeGPT2(nn.Module):
+    """GPT-2 with every ``moe_every``-th block routed (1 = all MoE)."""
+
+    vocab_size: int = 50257
+    max_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    num_experts: int = 8
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, L = tokens.shape
+        if L > self.max_len:
+            raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
+        wte = nn.Embed(
+            self.vocab_size,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="wte",
+        )
+        wpe = nn.Embed(
+            self.max_len,
+            self.embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.01), ("pos", "embed")
+            ),
+            name="wpe",
+        )
+        x = wte(tokens) + wpe(jnp.arange(L)[None, :])
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = constrain(x, "batch", "seq", "embed")
+        head_dim = self.embed_dim // self.num_heads
+        for i in range(self.num_layers):
+            # GShard-style interleave: MoE on blocks 1, 3, ... (moe_every=2).
+            if (i + 1) % self.moe_every == 0:
+                x = MoeTransformerBlock(
+                    self.num_heads,
+                    head_dim,
+                    4 * self.embed_dim,
+                    num_experts=self.num_experts,
+                    num_selected=self.num_selected,
+                    capacity_factor=self.capacity_factor,
+                    causal=True,
+                    activation="gelu_tanh",
+                    dropout_rate=self.dropout_rate,
+                    dtype=self.dtype,
+                    name=f"block_{i}",
+                )(x, None, not train)
+            else:
+                x = TransformerBlock(
+                    self.num_heads,
+                    head_dim,
+                    4 * self.embed_dim,
+                    pre_ln=True,
+                    causal=True,
+                    activation="gelu_tanh",
+                    ln_eps=1e-5,
+                    dropout_rate=self.dropout_rate,
+                    dtype=self.dtype,
+                    name=f"block_{i}",
+                )(x, None, not train)
+        x = layer_norm(1e-5, self.dtype, "ln_f")(x)
+        logits = wte.attend(x)
+        return logits.astype(jnp.float32)
+
+
+@register("gpt2_moe")
+def gpt2_moe(size: str = "tiny", **kwargs):
+    sizes = {
+        "tiny": (2, 4, 64),
+        "124m": (12, 12, 768),
+    }
+    n_l, n_h, d = sizes[size]
+    defaults = dict(num_layers=n_l, num_heads=n_h, embed_dim=d)
+    defaults.update(kwargs)
+    return MoeGPT2(**defaults)

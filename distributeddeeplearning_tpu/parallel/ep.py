@@ -1,0 +1,96 @@
+"""Expert parallelism — MoE token routing over the ``ep`` mesh axis.
+
+The reference family implements MoE with per-rank expert processes and NCCL
+all-to-all token exchange; the TPU-native formulation (GShard / Switch
+lineage, SURVEY.md §2b) is pure einsum algebra: a dispatch one-hot scatters
+tokens into per-expert capacity buffers, experts run as one batched matmul
+over a leading expert dim carrying the ``expert`` logical axis (-> ``ep``
+mesh axis), and a combine tensor gathers the results back. With tokens
+sharded over batch (``dp``) and experts over ``ep``, the XLA SPMD partitioner
+emits the token all-to-alls; there is no hand-written exchange.
+
+Everything here is static-shape: capacity is a Python int computed at trace
+time, overflowing tokens are dropped (standard capacity-factor semantics),
+so the MXU sees fixed [experts, capacity, d] batches every step.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(
+    tokens_per_group: int,
+    num_experts: int,
+    num_selected: int,
+    capacity_factor: float,
+) -> int:
+    """Per-expert buffer length (static)."""
+    return max(
+        1,
+        int(
+            math.ceil(
+                tokens_per_group * num_selected * capacity_factor / num_experts
+            )
+        ),
+    )
+
+
+def route_top_k(router_probs, num_selected: int, capacity: int):
+    """Token-choice top-k routing with per-group capacity.
+
+    router_probs: [groups, tokens, experts] softmax outputs.
+    Returns (dispatch, combine, aux_loss):
+      dispatch: [g, t, e, c] one-hot — token t of group g occupies slot c of
+        expert e (all-zero row = dropped token);
+      combine:  same shape, dispatch scaled by the (renormalized) gate;
+      aux_loss: scalar Switch-style load-balancing loss (mean over groups of
+        num_experts * sum_e fraction_dispatched_e * mean_prob_e).
+
+    Routing is deterministic in token order, so sharded and unsharded
+    executions agree exactly — the property the EP parity tests assert.
+    """
+    g, t, e = router_probs.shape
+    gate_vals, expert_idx = jax.lax.top_k(router_probs, num_selected)  # [g,t,k]
+    # Renormalize the selected gates so they sum to 1 per token.
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9
+    )
+
+    dispatch = jnp.zeros((g, t, e, capacity), router_probs.dtype)
+    combine = jnp.zeros((g, t, e, capacity), router_probs.dtype)
+    counts = jnp.zeros((g, e), jnp.int32)  # tokens already queued per expert
+    for i in range(num_selected):
+        onehot_e = jax.nn.one_hot(expert_idx[..., i], e, dtype=jnp.int32)
+        # Slot index = running count of earlier tokens (and earlier choices)
+        # bound for the same expert.
+        pos = jnp.cumsum(onehot_e, axis=1) - onehot_e + counts[:, None, :]
+        slot = (pos * onehot_e).sum(-1)  # [g, t]
+        keep = slot < capacity
+        disp_i = (
+            onehot_e.astype(router_probs.dtype)[..., None]
+            * jax.nn.one_hot(
+                jnp.where(keep, slot, 0), capacity, dtype=router_probs.dtype
+            )[:, :, None, :]
+            * keep[..., None, None]
+        )
+        dispatch = dispatch + disp_i
+        combine = combine + gate_vals[..., i][..., None, None] * disp_i
+        counts = counts + onehot_e.sum(1)
+
+    # Load-balancing aux loss over FIRST choices (Switch convention).
+    first = jax.nn.one_hot(expert_idx[..., 0], e, dtype=router_probs.dtype)
+    fraction = first.mean(1)  # [g, e] fraction of tokens whose top-1 is e
+    prob_mean = router_probs.mean(1)  # [g, e]
+    aux_loss = (e * (fraction * prob_mean).sum(-1)).mean()
+    return dispatch, combine, aux_loss
+
+
+def check_moe_shapes(num_experts: int, ep: int) -> None:
+    if num_experts % ep:
+        raise ValueError(
+            f"moe: num_experts={num_experts} not divisible by ep={ep}"
+        )

@@ -188,6 +188,10 @@ class Trainer:
         self.tx = tx
         self.task = task
         self.mesh = mesh
+        if hasattr(model, "num_experts"):
+            from .parallel.ep import check_moe_shapes
+
+            check_moe_shapes(model.num_experts, mesh.shape["ep"])
         self.rules = rules
         self.grad_accum = grad_accum
         self.zero1 = zero1
@@ -206,6 +210,8 @@ class Trainer:
                 {"params": p_rng, "dropout": d_rng}, *example_inputs, train=False
             )
         params = variables.pop("params")
+        # sow()-collections are per-step outputs, not persistent state.
+        variables.pop("losses", None)
         opt_state = self.tx.init(params)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
@@ -273,7 +279,10 @@ class Trainer:
 
     def _loss_and_updates(self, params, model_state, batch, rng, train: bool):
         variables = {"params": params, **model_state}
-        mutable = list(model_state.keys()) if train else []
+        # "losses" collects model-internal objective terms sown during the
+        # forward pass (e.g. the MoE router's load-balancing loss); it is
+        # folded into the objective here and never persisted into the state.
+        mutable = list(model_state.keys()) + ["losses"] if train else []
         inputs = self.task.input_fn(batch)
         with nn.logical_axis_rules(self.rules):
             if mutable:
@@ -281,12 +290,18 @@ class Trainer:
                     variables, *inputs, train=train, mutable=mutable,
                     rngs={"dropout": rng},
                 )
+                updates = dict(updates)
             else:
                 out = self.model.apply(
                     variables, *inputs, train=train, rngs={"dropout": rng}
                 )
-                updates = model_state
+                updates = dict(model_state)
+        aux = updates.pop("losses", None)
         loss, metrics = self.task.loss_fn(out, batch)
+        if aux:
+            aux_total = sum(jnp.sum(v) for v in jax.tree.leaves(aux))
+            loss = loss + aux_total
+            metrics = {**metrics, "aux_loss": aux_total}
         return loss, (metrics, updates)
 
     def _make_train_step(self):
