@@ -47,6 +47,7 @@ class MoeMlp(nn.Module):
     capacity_factor: float = 1.25
     activation: str = "gelu_exact"
     aux_loss_weight: float = 1e-2
+    dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     init_scale: float = 0.02
 
@@ -124,7 +125,10 @@ class MoeMlp(nn.Module):
         out = constrain(out, "expert", "batch", None, "embed")
         # Gather back to token order; dropped tokens contribute zero (the
         # residual connection around the block carries them through).
-        return jnp.einsum("gtec,egcd->gtd", combine.astype(x.dtype), out)
+        out = jnp.einsum("gtec,egcd->gtd", combine.astype(x.dtype), out)
+        # Same trailing dropout as the dense Mlp, so interleaved MoE/dense
+        # blocks regularize identically.
+        return nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)
 
 
 class MoeTransformerBlock(nn.Module):
@@ -163,6 +167,7 @@ class MoeTransformerBlock(nn.Module):
             num_selected=self.num_selected,
             capacity_factor=self.capacity_factor,
             activation=self.activation,
+            dropout_rate=self.dropout_rate,
             dtype=self.dtype,
             init_scale=self.init_scale,
             name="moe_mlp",

@@ -50,7 +50,9 @@ class SelfAttention(nn.Module):
                    the Ulysses all-to-alls around it (``cp`` mesh axis);
     - ``ring``:    explicit shard_map ring attention over ``cp`` with
                    ppermute KV rotation (``ops/ring_attention.py``); needs
-                   ``mesh`` and supports mask=None, dropout=0 only.
+                   ``mesh`` and supports mask=None, dropout=0 only;
+    - ``flash``:   fused Pallas flash-attention kernel
+                   (``ops/flash_attention.py``); mask=None, dropout=0 only.
     """
 
     num_heads: int
@@ -59,7 +61,7 @@ class SelfAttention(nn.Module):
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.float32
     init_scale: float = 0.02
-    attn_impl: str = "xla"  # xla | ulysses | ring
+    attn_impl: str = "xla"  # xla | ulysses | ring | flash
     mesh: object = None  # jax.sharding.Mesh, required for attn_impl='ring'
 
     @nn.compact
@@ -80,7 +82,16 @@ class SelfAttention(nn.Module):
         k = proj("key")(x)
         v = proj("value")(x)
 
-        if self.attn_impl == "ring":
+        if self.attn_impl == "flash":
+            if mask is not None or (self.dropout_rate and not deterministic):
+                raise NotImplementedError(
+                    "flash attention supports mask=None and no active "
+                    "attention-dropout"
+                )
+            from ..ops import flash_attention
+
+            out = flash_attention(q, k, v, causal=self.causal)
+        elif self.attn_impl == "ring":
             if mask is not None or (self.dropout_rate and not deterministic):
                 raise NotImplementedError(
                     "ring attention supports mask=None and no active "

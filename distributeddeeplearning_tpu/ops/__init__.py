@@ -6,4 +6,5 @@ variants (ring attention) are explicit shard_map programs; Pallas Mosaic
 kernels provide fused alternatives for the hot ops on real TPU.
 """
 
+from .flash_attention import attention_reference, flash_attention  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401

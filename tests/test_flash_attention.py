@@ -1,0 +1,112 @@
+"""Pallas flash-attention kernel vs the pure-jnp oracle (interpret mode).
+
+SURVEY.md §4 tier 1: Pallas kernels are tested on CPU in interpret mode
+against materialized-softmax references; the real-chip compile smoke lives
+in test_tpu_smoke (tier 4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.ops import (
+    attention_reference,
+    flash_attention,
+)
+
+
+def _qkv(key, b=2, s=256, h=2, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, s, h, d)
+    mk = lambda k: jax.random.normal(k, shape, jnp.float32).astype(dtype)  # noqa: E731
+    return mk(kq), mk(kk), mk(kv)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_bf16():
+    q, k, v = _qkv(jax.random.PRNGKey(1), dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        out.astype(np.float32), ref.astype(np.float32), atol=2e-2, rtol=2e-2
+    )
+
+
+def test_multi_block_unequal_blocks():
+    # 4 q-blocks x 2 kv-blocks exercises the scratch-carry across the grid.
+    q, k, v = _qkv(jax.random.PRNGKey(2), s=256)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=128)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(3), s=128, d=32)
+    w = jax.random.normal(jax.random.PRNGKey(4), q.shape)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=causal) * w)
+
+    g_flash = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(attention_reference), argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            gf, gr, atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_grads_under_jit_and_blocks():
+    q, k, v = _qkv(jax.random.PRNGKey(5), s=128, d=32)
+
+    @jax.jit
+    def g(q, k, v):
+        f = lambda *a: jnp.sum(  # noqa: E731
+            flash_attention(*a, causal=True, block_q=32, block_k=64) ** 2
+        )
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    r = lambda *a: jnp.sum(  # noqa: E731
+        attention_reference(*a, causal=True) ** 2
+    )
+    g_ref = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g(q, k, v), g_ref):
+        np.testing.assert_allclose(gf, gr, atol=5e-5, rtol=5e-5)
+
+
+def test_indivisible_seq_raises():
+    q, k, v = _qkv(jax.random.PRNGKey(6), s=96)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+def test_transformer_flash_matches_xla():
+    """GPT-2-shaped block: attn_impl='flash' == attn_impl='xla' fwd + grads."""
+    from distributeddeeplearning_tpu.models.transformer import TransformerStack
+
+    def make(impl):
+        return TransformerStack(
+            num_layers=2, num_heads=4, head_dim=16, mlp_dim=128,
+            causal=True, attn_impl=impl,
+        )
+
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 64, 64))
+    params = make("xla").init(jax.random.PRNGKey(8), x)
+    out_x = make("xla").apply(params, x)
+    out_f = make("flash").apply(params, x)
+    np.testing.assert_allclose(out_f, out_x, atol=1e-5, rtol=1e-5)
+
+    gx = jax.grad(lambda p: jnp.sum(make("xla").apply(p, x) ** 2))(params)
+    gf = jax.grad(lambda p: jnp.sum(make("flash").apply(p, x) ** 2))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4),
+        gx, gf,
+    )
