@@ -7,4 +7,5 @@ kernels provide fused alternatives for the hot ops on real TPU.
 """
 
 from .flash_attention import attention_reference, flash_attention  # noqa: F401
+from .fused_adamw import fused_adamw  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401

@@ -104,10 +104,7 @@ def _fwd_kernel(
     def _finalize():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        # Lane-broadcast layout (block_q, 128) to satisfy Mosaic tiling.
-        lse_ref[0] = jnp.broadcast_to(
-            m_scr[:, :1] + jnp.log(l), lse_ref.shape[1:]
-        )
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -132,13 +129,14 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-            # lse lane-broadcast to 128 wide (Mosaic (8,128) tiling rule);
-            # readers take [:, :1].
-            jax.ShapeDtypeStruct((bh, seq, _LANES), jnp.float32),
+            # Trailing singleton lane dim: satisfies Mosaic's tiling rule
+            # (last block dim == array dim) without the 128x lane-broadcast
+            # a (bh, seq) layout would force on this residual.
+            jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -165,7 +163,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki, bq, bk):
         row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         col = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(row >= col, s, _NEG_INF)
-    return jnp.exp(s - lse_ref[0, :, :1])  # masked entries -> exactly 0
+    return jnp.exp(s - lse_ref[0])  # lse block is (bq, 1); masked -> 0
 
 
 def _delta(o_ref, do_ref):
@@ -268,7 +266,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 
     q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     k_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    lse_spec_q = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    lse_spec_q = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -289,7 +287,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
     # dK/dV: kv blocks outer, q blocks inner.
     q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
     k_spec_k = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
-    lse_spec_k = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, j, 0))
+    lse_spec_k = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal,

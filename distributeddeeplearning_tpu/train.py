@@ -153,6 +153,10 @@ def make_optimizer(
             tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
     elif name == "adamw":
         tx = optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay)
+    elif name == "adamw_fused":
+        from .ops.fused_adamw import fused_adamw
+
+        tx = fused_adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay)
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     if grad_clip:

@@ -11,6 +11,10 @@ already imported jax). Real-TPU smoke tests opt back in via the
 
 import os
 
+# Captured BEFORE the pop so @pytest.mark.tpu tests (helpers.run_on_tpu) can
+# restore the real-chip environment in their subprocess.
+TPU_POOL_IPS = os.environ.get("PALLAS_AXON_POOL_IPS")
+
 # For any subprocesses tests spawn.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -41,6 +45,12 @@ def make_mesh(**axis_sizes):
     except dp which absorbs the remainder unless given."""
     cfg = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig(dp=8)
     return build_mesh(cfg)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: needs the real TPU chip (runs in a subprocess)"
+    )
 
 
 @pytest.fixture

@@ -5,6 +5,9 @@ tiny-GPT-2 parity train loop every parallelism-strategy test reuses
 from __future__ import annotations
 
 import math
+import os
+import subprocess
+import sys
 
 import jax
 
@@ -12,6 +15,33 @@ from distributeddeeplearning_tpu import data as data_lib
 from distributeddeeplearning_tpu import models
 from distributeddeeplearning_tpu.mesh import MeshConfig, build_mesh
 from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
+
+
+def run_on_tpu(code: str, timeout: int = 540) -> str:
+    """Run a Python snippet in a subprocess against the real TPU chip.
+
+    The pytest process is pinned to the 8-device CPU sim (conftest), so
+    real-chip smoke tests (SURVEY §4 tier 4) restore the axon environment in
+    a child process instead. Skips when no chip is attached. Returns stdout.
+    """
+    import conftest
+    import pytest
+
+    if not conftest.TPU_POOL_IPS:
+        pytest.skip("no TPU attached (PALLAS_AXON_POOL_IPS unset)")
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = conftest.TPU_POOL_IPS
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, (
+        f"TPU subprocess failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    return proc.stdout
 
 
 def mesh_of(**axes):

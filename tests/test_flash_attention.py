@@ -2,7 +2,7 @@
 
 SURVEY.md §4 tier 1: Pallas kernels are tested on CPU in interpret mode
 against materialized-softmax references; the real-chip compile smoke lives
-in test_tpu_smoke (tier 4).
+in tests/test_tpu_smoke.py (tier 4).
 """
 
 import jax
