@@ -34,6 +34,9 @@ class DataConfig:
     vocab_size: int = 1024
     n_distinct: int = 8
     seed: int = 0
+    path: str = ""  # record_file_image: binary record file
+    num_threads: int = 2  # native loader worker threads
+    prefetch_depth: int = 4  # native loader ring depth
 
     def dataset_kwargs(self) -> dict[str, Any]:
         """Kwargs for this kind's dataset class: the intersection of its

@@ -144,6 +144,17 @@ DATASET_KINDS: dict[str, type] = {
     "synthetic_mlm": SyntheticMLM,
 }
 
+# Native (C++) loader kinds degrade gracefully: the wrapper classes fall back
+# to numpy when the toolchain is missing, and a broken native module must not
+# take down the pure-Python kinds above.
+try:
+    from .native.loader import NativeSyntheticImages, RecordFileImages
+
+    DATASET_KINDS["native_image"] = NativeSyntheticImages
+    DATASET_KINDS["record_file_image"] = RecordFileImages
+except ImportError:  # pragma: no cover
+    pass
+
 
 def make_dataset(kind: str, **kwargs):
     if kind not in DATASET_KINDS:

@@ -1,0 +1,11 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The TPU compute path is XLA/Pallas; host-side runtime work that the
+reference implements natively (its DataLoader, ``BASELINE.json:5``) is
+native here too. Libraries are compiled on first use with the system
+toolchain and cached next to the sources; every native component has a
+pure-Python fallback so the framework degrades gracefully on hosts
+without a compiler.
+"""
+
+from .build import load_library, native_available  # noqa: F401

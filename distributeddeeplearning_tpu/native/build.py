@@ -1,0 +1,54 @@
+"""On-demand compilation + ctypes loading of the native components.
+
+No pybind11 in this environment, so bindings are plain C ABI + ctypes.
+The .so is rebuilt only when the source is newer (mtime), making import
+cost a stat() in the common case. Compilation failures degrade to
+``native_available() == False`` — callers fall back to Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE: dict[str, ctypes.CDLL | None] = {}
+
+_CXX_FLAGS = ["-O3", "-shared", "-fPIC", "-pthread", "-std=c++17", "-Wall"]
+
+
+def _build(name: str) -> str | None:
+    src = os.path.join(_DIR, f"{name}.cc")
+    # "lib" prefix: a bare <name>.so would shadow <name>.py in the package
+    # (Python prefers extension modules over .py files).
+    out = os.path.join(_DIR, f"lib{name}.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cxx = os.environ.get("CXX", "g++")
+    try:
+        subprocess.run(
+            [cxx, *_CXX_FLAGS, src, "-o", out],
+            check=True, capture_output=True, text=True, timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
+
+
+def load_library(name: str = "loader") -> ctypes.CDLL | None:
+    """Compile (if needed) and dlopen native/<name>.cc. None on failure."""
+    with _LOCK:
+        if name not in _CACHE:
+            path = _build(name)
+            try:
+                _CACHE[name] = ctypes.CDLL(path) if path else None
+            except OSError:
+                _CACHE[name] = None
+        return _CACHE[name]
+
+
+def native_available(name: str = "loader") -> bool:
+    return load_library(name) is not None

@@ -1,0 +1,330 @@
+// Native host-side data loader — C++ runtime component.
+//
+// TPU-native counterpart of the reference's host-side DataLoader
+// (BASELINE.json:5): the TPU compute path is XLA/Pallas, but batch
+// assembly is host CPU work, so it is native code here exactly as it is
+// in the reference. Two modes:
+//
+//  - synthetic: xoshiro256++-derived uniform floats + integer labels,
+//    deterministic in (seed, batch_index) — mirrors the Python
+//    SyntheticImages contract (index-addressable => step-exact resume);
+//  - file: fixed-size binary records (CIFAR-10 layout: label byte(s) +
+//    uint8 sample payload), shuffled per epoch with a seeded
+//    Fisher-Yates permutation, normalized to float32 in [0, 1).
+//
+// Batches are produced by a small worker pool into a ring of
+// preallocated slots; the consumer thread blocks on the slot for the
+// next index. Every batch is computed purely from its index, so workers
+// need no shared mutable state beyond the claim counter, and
+// start(index) gives exact resume. Exposed as a C ABI for ctypes
+// (no pybind11 in this image).
+//
+// Build: g++ -O3 -shared -fPIC -pthread -std=c++17 loader.cc -o ddl_loader.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <memory>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// splitmix64: seeds the per-batch generator from (seed, index) so any
+// batch is computable independently (no sequential RNG state).
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct Rng {  // xoshiro256++
+  uint64_t s[4];
+  explicit Rng(uint64_t seed) {
+    for (int i = 0; i < 4; ++i) s[i] = seed = splitmix64(seed);
+  }
+  static inline uint64_t rotl(uint64_t x, int k) {
+    return (x << k) | (x >> (64 - k));
+  }
+  uint64_t next() {
+    uint64_t result = rotl(s[0] + s[3], 23) + s[0];
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0]; s[3] ^= s[1]; s[1] ^= s[2]; s[0] ^= s[3]; s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+  float uniform() {  // [0, 1)
+    return (next() >> 40) * (1.0f / (1ull << 24));
+  }
+  int64_t below(int64_t n) { return static_cast<int64_t>(next() % n); }
+};
+
+struct Config {
+  int64_t batch = 0;
+  int64_t sample_floats = 0;  // floats per sample in the output buffer
+  int64_t num_classes = 0;
+  uint64_t seed = 0;
+  int threads = 2;
+  int depth = 4;  // prefetch ring depth
+  // file mode
+  std::string path;
+  int64_t record_bytes = 0;
+  int64_t label_bytes = 0;  // leading bytes holding the label (LE int)
+  bool shuffle = true;
+};
+
+class Loader {
+ public:
+  explicit Loader(Config cfg) : cfg_(std::move(cfg)) {
+    // One in-flight claim per worker; more workers than ring slots would
+    // let two claims race for the same slot.
+    if (cfg_.threads > cfg_.depth) cfg_.threads = cfg_.depth;
+    if (cfg_.threads < 1) cfg_.threads = 1;
+    if (!cfg_.path.empty()) {
+      FILE* f = std::fopen(cfg_.path.c_str(), "rb");
+      if (!f) throw std::runtime_error("cannot open " + cfg_.path);
+      std::fseek(f, 0, SEEK_END);
+      int64_t size = std::ftell(f);
+      std::fseek(f, 0, SEEK_SET);
+      num_records_ = size / cfg_.record_bytes;
+      if (num_records_ <= 0) {
+        std::fclose(f);
+        throw std::runtime_error("no records in " + cfg_.path);
+      }
+      file_.resize(static_cast<size_t>(num_records_) * cfg_.record_bytes);
+      if (std::fread(file_.data(), 1, file_.size(), f) != file_.size()) {
+        std::fclose(f);
+        throw std::runtime_error("short read on " + cfg_.path);
+      }
+      std::fclose(f);
+    }
+    for (int i = 0; i < cfg_.depth; ++i) {
+      auto s = std::make_unique<Slot>();
+      s->data.resize(cfg_.batch * cfg_.sample_floats);
+      s->labels.resize(cfg_.batch);
+      s->index.store(-1, std::memory_order_relaxed);
+      slots_.push_back(std::move(s));
+    }
+  }
+
+  ~Loader() { Stop(); }
+
+  int64_t num_records() const { return num_records_; }
+
+  // Fill caller buffers synchronously with batch `index` (used for
+  // batch(i) shape probes and as the determinism oracle in tests).
+  void Fill(int64_t index, float* data, int32_t* labels) {
+    FillBuffers(index, data, labels);
+  }
+
+  void Start(int64_t start_index) {
+    Stop();
+    stop_.store(false, std::memory_order_relaxed);
+    next_claim_.store(start_index, std::memory_order_relaxed);
+    next_out_ = start_index;
+    start_ = start_index;
+    for (auto& s : slots_) s->index.store(kFresh, std::memory_order_relaxed);
+    for (int i = 0; i < cfg_.threads; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  // Copy the next batch (in index order) into caller buffers.
+  // Returns the batch index.
+  int64_t Next(float* data, int32_t* labels) {
+    int64_t want = next_out_++;
+    Slot& slot = *slots_[want % slots_.size()];
+    {
+      std::unique_lock<std::mutex> lk(slot.m);
+      slot.cv.wait(lk, [&] {
+        return slot.index.load(std::memory_order_acquire) == want;
+      });
+      std::memcpy(data, slot.data.data(), slot.data.size() * sizeof(float));
+      std::memcpy(labels, slot.labels.data(),
+                  slot.labels.size() * sizeof(int32_t));
+      // Record WHICH batch was consumed (encoded negative): the worker
+      // holding claim `want + depth` — and only that one — may refill.
+      slot.index.store(Consumed(want), std::memory_order_release);
+    }
+    slot.cv.notify_all();
+    return want;
+  }
+
+  void Stop() {
+    stop_.store(true, std::memory_order_relaxed);
+    for (auto& s : slots_) {
+      // Lock-then-notify: without taking the slot mutex a waiter that has
+      // evaluated its predicate (stop_ still false) but not yet gone to
+      // sleep would miss this notification forever (lost-wakeup race).
+      { std::lock_guard<std::mutex> lk(s->m); }
+      s->cv.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+    workers_.clear();
+  }
+
+ private:
+  struct Slot {
+    std::mutex m;
+    std::condition_variable cv;
+    std::atomic<int64_t> index{-1};
+    std::vector<float> data;
+    std::vector<int32_t> labels;
+  };
+
+  static constexpr int64_t kFresh = -1;
+  static int64_t Consumed(int64_t batch) { return -batch - 2; }
+
+  void WorkerLoop() {
+    while (!stop_.load(std::memory_order_relaxed)) {
+      int64_t idx = next_claim_.fetch_add(1, std::memory_order_relaxed);
+      Slot& slot = *slots_[idx % slots_.size()];
+      int64_t depth = static_cast<int64_t>(slots_.size());
+      std::unique_lock<std::mutex> lk(slot.m);
+      // Strict turn order per slot: claim `idx` may fill only a fresh slot
+      // (first lap) or one whose previous occupant `idx - depth` was
+      // consumed. Claims `depth` apart map to the same slot, so a plain
+      // "slot is free" check would let a later claim overtake an earlier
+      // one and deadlock the consumer.
+      slot.cv.wait(lk, [&] {
+        int64_t cur = slot.index.load(std::memory_order_acquire);
+        return stop_.load(std::memory_order_relaxed) ||
+               (cur == kFresh && idx - start_ < depth) ||
+               cur == Consumed(idx - depth);
+      });
+      if (stop_.load(std::memory_order_relaxed)) return;
+      FillBuffers(idx, slot.data.data(), slot.labels.data());
+      slot.index.store(idx, std::memory_order_release);
+      lk.unlock();
+      slot.cv.notify_all();
+    }
+  }
+
+  void FillBuffers(int64_t index, float* data, int32_t* labels) {
+    if (file_.empty()) {
+      Rng rng(splitmix64(cfg_.seed) ^ static_cast<uint64_t>(index));
+      int64_t n = cfg_.batch * cfg_.sample_floats;
+      for (int64_t i = 0; i < n; ++i) data[i] = rng.uniform();
+      for (int64_t i = 0; i < cfg_.batch; ++i)
+        labels[i] = static_cast<int32_t>(rng.below(cfg_.num_classes));
+    } else {
+      int64_t payload = cfg_.record_bytes - cfg_.label_bytes;
+      for (int64_t i = 0; i < cfg_.batch; ++i) {
+        int64_t global = index * cfg_.batch + i;
+        int64_t epoch = global / num_records_;
+        int64_t pos = global % num_records_;
+        int64_t rec = cfg_.shuffle ? Permuted(epoch, pos) : pos;
+        const uint8_t* p = file_.data() + rec * cfg_.record_bytes;
+        int64_t label = 0;
+        for (int64_t b = 0; b < cfg_.label_bytes; ++b)
+          label |= static_cast<int64_t>(p[b]) << (8 * b);
+        labels[i] = static_cast<int32_t>(label);
+        float* out = data + i * cfg_.sample_floats;
+        const uint8_t* s = p + cfg_.label_bytes;
+        for (int64_t b = 0; b < payload; ++b)
+          out[b] = s[b] * (1.0f / 255.0f);
+      }
+    }
+  }
+
+  // Element `pos` of the epoch's Fisher-Yates permutation. Permutations
+  // are cached per epoch (training touches epochs in order; the cache
+  // keeps the two neighbouring epochs a batch straddle can touch).
+  int64_t Permuted(int64_t epoch, int64_t pos) {
+    std::lock_guard<std::mutex> lk(perm_m_);
+    auto it = perms_.find(epoch);
+    if (it == perms_.end()) {
+      std::vector<int32_t> perm(num_records_);
+      std::iota(perm.begin(), perm.end(), 0);
+      Rng rng(splitmix64(cfg_.seed ^ 0xda7a5e7ull) ^
+              static_cast<uint64_t>(epoch));
+      for (int64_t i = num_records_ - 1; i > 0; --i)
+        std::swap(perm[i], perm[rng.below(i + 1)]);
+      if (perms_.size() > 2) perms_.clear();
+      it = perms_.emplace(epoch, std::move(perm)).first;
+    }
+    return it->second[pos];
+  }
+
+  Config cfg_;
+  std::vector<uint8_t> file_;
+  int64_t num_records_ = 0;
+  std::vector<std::unique_ptr<Slot>> slots_;
+  std::vector<std::thread> workers_;
+  std::atomic<bool> stop_{false};
+  std::atomic<int64_t> next_claim_{0};
+  int64_t next_out_ = 0;
+  int64_t start_ = 0;
+  std::mutex perm_m_;
+  std::unordered_map<int64_t, std::vector<int32_t>> perms_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ddl_loader_create_synthetic(int64_t batch, int64_t sample_floats,
+                                  int64_t num_classes, uint64_t seed,
+                                  int threads, int depth) {
+  Config cfg;
+  cfg.batch = batch;
+  cfg.sample_floats = sample_floats;
+  cfg.num_classes = num_classes;
+  cfg.seed = seed;
+  cfg.threads = threads;
+  cfg.depth = depth;
+  try {
+    return new Loader(std::move(cfg));
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void* ddl_loader_create_file(const char* path, int64_t batch,
+                             int64_t record_bytes, int64_t label_bytes,
+                             uint64_t seed, int threads, int depth,
+                             int shuffle) {
+  Config cfg;
+  cfg.path = path;
+  cfg.batch = batch;
+  cfg.record_bytes = record_bytes;
+  cfg.label_bytes = label_bytes;
+  cfg.sample_floats = record_bytes - label_bytes;
+  cfg.seed = seed;
+  cfg.threads = threads;
+  cfg.depth = depth;
+  cfg.shuffle = shuffle != 0;
+  try {
+    return new Loader(std::move(cfg));
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+int64_t ddl_loader_num_records(void* loader) {
+  return static_cast<Loader*>(loader)->num_records();
+}
+
+void ddl_loader_fill(void* loader, int64_t index, float* data,
+                     int32_t* labels) {
+  static_cast<Loader*>(loader)->Fill(index, data, labels);
+}
+
+void ddl_loader_start(void* loader, int64_t start_index) {
+  static_cast<Loader*>(loader)->Start(start_index);
+}
+
+int64_t ddl_loader_next(void* loader, float* data, int32_t* labels) {
+  return static_cast<Loader*>(loader)->Next(data, labels);
+}
+
+void ddl_loader_destroy(void* loader) { delete static_cast<Loader*>(loader); }
+
+}  // extern "C"

@@ -1,0 +1,283 @@
+"""ctypes bindings + IndexedDataset adapters for the C++ loader.
+
+Two dataset kinds (registered in ``data.DATASET_KINDS``):
+
+- ``native_image`` — synthetic images assembled by the C++ worker pool
+  (the native analogue of ``SyntheticImages``; values differ — the C++
+  generator is xoshiro — but the contract is the same: batch ``i`` is a
+  pure function of ``(seed, i)``).
+- ``record_file_image`` — fixed-size binary records (CIFAR-10 binary
+  layout: ``label_bytes`` leading label + uint8 payload), per-epoch
+  seeded shuffle, normalized to [0, 1) float32.
+
+Both fall back to pure-numpy implementations when the toolchain can't
+produce the shared library, so tests and CPU-only hosts keep working.
+``iter_from`` streams through the threaded prefetch ring; ``batch(i)``
+uses the synchronous fill path (shape probes, resume oracles).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+
+import numpy as np
+
+from .build import load_library
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.ddl_loader_create_synthetic.restype = ctypes.c_void_p
+    lib.ddl_loader_create_synthetic.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int,
+    ]
+    lib.ddl_loader_create_file.restype = ctypes.c_void_p
+    lib.ddl_loader_create_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.ddl_loader_num_records.restype = ctypes.c_int64
+    lib.ddl_loader_num_records.argtypes = [ctypes.c_void_p]
+    lib.ddl_loader_fill.restype = None
+    lib.ddl_loader_fill.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, f32p, i32p,
+    ]
+    lib.ddl_loader_start.restype = None
+    lib.ddl_loader_start.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ddl_loader_next.restype = ctypes.c_int64
+    lib.ddl_loader_next.argtypes = [ctypes.c_void_p, f32p, i32p]
+    lib.ddl_loader_destroy.restype = None
+    lib.ddl_loader_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _lib() -> ctypes.CDLL | None:
+    lib = load_library("loader")
+    return _bind(lib) if lib is not None else None
+
+
+class _Handle:
+    """Owns one C++ Loader; releases it on GC."""
+
+    def __init__(self, lib, ptr):
+        if not ptr:
+            raise RuntimeError("native loader creation failed")
+        self.lib = lib
+        self.ptr = ptr
+
+    def __del__(self):
+        if getattr(self, "ptr", None):
+            self.lib.ddl_loader_destroy(self.ptr)
+            self.ptr = None
+
+    def fill(self, index: int, data: np.ndarray, labels: np.ndarray):
+        self.lib.ddl_loader_fill(
+            self.ptr, index,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+
+    def start(self, index: int):
+        self.lib.ddl_loader_start(self.ptr, index)
+
+    def next(self, data: np.ndarray, labels: np.ndarray) -> int:
+        return self.lib.ddl_loader_next(
+            self.ptr,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+
+
+def _as_image(flat: np.ndarray, size: int, channels: int, layout: str):
+    b = flat.shape[0]
+    if layout == "chw":  # CIFAR-10 binary is planar; models are NHWC
+        return flat.reshape(b, channels, size, size).transpose(0, 2, 3, 1)
+    return flat.reshape(b, size, size, channels)
+
+
+@dataclasses.dataclass
+class NativeSyntheticImages:
+    """Synthetic image batches assembled by the C++ worker pool."""
+
+    batch_size: int
+    image_size: int = 32
+    channels: int = 3
+    num_classes: int = 10
+    seed: int = 0
+    num_threads: int = 2
+    prefetch_depth: int = 4
+
+    def __post_init__(self):
+        self._sample = self.image_size * self.image_size * self.channels
+        self._gen = 0  # stream generation; guards concurrent iterators
+        lib = _lib()
+        self._h = None
+        if lib is not None:
+            self._h = _Handle(
+                lib,
+                lib.ddl_loader_create_synthetic(
+                    self.batch_size, self._sample, self.num_classes,
+                    self.seed, self.num_threads, self.prefetch_depth,
+                ),
+            )
+
+    def _buffers(self):
+        return (
+            np.empty((self.batch_size, self._sample), np.float32),
+            np.empty((self.batch_size,), np.int32),
+        )
+
+    def _pack(self, data, labels):
+        return {
+            "image": _as_image(data, self.image_size, self.channels, "hwc"),
+            "label": labels,
+        }
+
+    def batch(self, index: int):
+        if self._h is None:  # Python fallback
+            from ..data import SyntheticImages
+
+            return SyntheticImages(
+                self.batch_size, self.image_size, self.channels,
+                self.num_classes, self.seed, n_distinct=0,
+            ).batch(index)
+        data, labels = self._buffers()
+        self._h.fill(index, data, labels)
+        return self._pack(data, labels)
+
+    def iter_from(self, start: int = 0):
+        if self._h is None:
+            while True:
+                yield self.batch(start)
+                start += 1
+        # One C++ prefetch ring per dataset: a newer iterator takes the
+        # stream over, and the superseded one fails loudly instead of
+        # silently yielding the new stream's batches.
+        self._gen += 1
+        gen = self._gen
+        self._h.start(start)
+        while True:
+            if self._gen != gen:
+                raise RuntimeError(
+                    "a newer iter_from() took over this native loader; "
+                    "create a separate dataset for concurrent iteration"
+                )
+            data, labels = self._buffers()
+            self._h.next(data, labels)
+            yield self._pack(data, labels)
+
+    def __iter__(self):
+        return self.iter_from(0)
+
+
+@dataclasses.dataclass
+class RecordFileImages:
+    """Binary fixed-record file (CIFAR-10 style) via the C++ loader."""
+
+    path: str
+    batch_size: int
+    image_size: int = 32
+    channels: int = 3
+    label_bytes: int = 1
+    layout: str = "chw"  # payload order in the file
+    shuffle: bool = True
+    seed: int = 0
+    num_threads: int = 2
+    prefetch_depth: int = 4
+
+    def __post_init__(self):
+        if not self.path:
+            raise ValueError("record_file_image requires data.path")
+        self._sample = self.image_size * self.image_size * self.channels
+        self._record = self._sample + self.label_bytes
+        self._gen = 0
+        self._perm_cache: dict[int, np.ndarray] = {}
+        lib = _lib()
+        self._h = None
+        self._np = None
+        if lib is not None:
+            self._h = _Handle(
+                lib,
+                lib.ddl_loader_create_file(
+                    self.path.encode(), self.batch_size, self._record,
+                    self.label_bytes, self.seed, self.num_threads,
+                    self.prefetch_depth, int(self.shuffle),
+                ),
+            )
+        else:
+            raw = np.fromfile(self.path, np.uint8)
+            self._np = raw.reshape(-1, self._record)
+
+    @property
+    def num_records(self) -> int:
+        if self._h is not None:
+            return int(self._h.lib.ddl_loader_num_records(self._h.ptr))
+        return len(self._np)
+
+    def _perm(self, epoch: int) -> np.ndarray:
+        if epoch not in self._perm_cache:
+            if len(self._perm_cache) > 2:  # a batch straddles <= 2 epochs
+                self._perm_cache.clear()
+            self._perm_cache[epoch] = np.random.default_rng(
+                (self.seed << 16) ^ epoch
+            ).permutation(len(self._np))
+        return self._perm_cache[epoch]
+
+    def _fallback_batch(self, index: int):
+        n = len(self._np)
+        idx = []
+        for i in range(self.batch_size):
+            g = index * self.batch_size + i
+            epoch, pos = divmod(g, n)
+            if self.shuffle:
+                pos = self._perm(epoch)[pos]
+            idx.append(pos)
+        recs = self._np[idx]
+        labels = recs[:, : self.label_bytes].astype(np.int32)
+        label = np.zeros((self.batch_size,), np.int32)
+        for b in range(self.label_bytes):
+            label |= labels[:, b] << (8 * b)
+        data = recs[:, self.label_bytes :].astype(np.float32) / 255.0
+        return {
+            "image": _as_image(data, self.image_size, self.channels, self.layout),
+            "label": label,
+        }
+
+    def _pack(self, data, labels):
+        return {
+            "image": _as_image(data, self.image_size, self.channels, self.layout),
+            "label": labels,
+        }
+
+    def batch(self, index: int):
+        if self._h is None:
+            return self._fallback_batch(index)
+        data = np.empty((self.batch_size, self._sample), np.float32)
+        labels = np.empty((self.batch_size,), np.int32)
+        self._h.fill(index, data, labels)
+        return self._pack(data, labels)
+
+    def iter_from(self, start: int = 0):
+        if self._h is None:
+            while True:
+                yield self._fallback_batch(start)
+                start += 1
+        self._gen += 1
+        gen = self._gen
+        self._h.start(start)
+        while True:
+            if self._gen != gen:
+                raise RuntimeError(
+                    "a newer iter_from() took over this native loader; "
+                    "create a separate dataset for concurrent iteration"
+                )
+            data = np.empty((self.batch_size, self._sample), np.float32)
+            labels = np.empty((self.batch_size,), np.int32)
+            self._h.next(data, labels)
+            yield self._pack(data, labels)
+
+    def __iter__(self):
+        return self.iter_from(0)

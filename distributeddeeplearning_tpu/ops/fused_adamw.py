@@ -159,11 +159,14 @@ def fused_adamw(
             ),
             params, grads, state.mu, state.nu,
         )
-        leaves = lambda i: jax.tree.map(  # noqa: E731
-            lambda x: x[i], out, is_leaf=lambda x: isinstance(x, tuple)
-        )
-        return leaves(0), FusedAdamWState(
-            count=count, mu=leaves(1), nu=leaves(2)
+        # Unzip the per-leaf (delta, m, v) triples by the params tree
+        # structure — duck-typing on tuples would misfire on params trees
+        # that themselves contain tuples.
+        treedef = jax.tree.structure(params)
+        triples = treedef.flatten_up_to(out)
+        unzip = lambda i: treedef.unflatten([t[i] for t in triples])  # noqa: E731
+        return unzip(0), FusedAdamWState(
+            count=count, mu=unzip(1), nu=unzip(2)
         )
 
     return optax.GradientTransformation(init_fn, update_fn)

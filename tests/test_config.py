@@ -61,15 +61,24 @@ def test_dataset_kwargs_cover_every_kind():
 
     from distributeddeeplearning_tpu import data as data_lib
 
-    for kind in data_lib.DATASET_KINDS:
-        cfg = dataclasses.replace(
-            Config().data, kind=kind, vocab_size=512, batch_size=4
-        )
-        ds = data_lib.make_dataset(kind, **cfg.dataset_kwargs())
-        assert ds.batch_size == 4
-        if hasattr(ds, "vocab_size"):
-            assert ds.vocab_size == 512
-        ds.batch(0)  # constructible and indexable
+    import tempfile
+
+    import numpy as np
+
+    with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+        # record_file_image needs a real record file: 8 records of
+        # 1 label byte + 32x32x3 uint8 payload (the DataConfig defaults).
+        np.zeros((8, 1 + 32 * 32 * 3), np.uint8).tofile(f.name)
+        for kind in data_lib.DATASET_KINDS:
+            cfg = dataclasses.replace(
+                Config().data, kind=kind, vocab_size=512, batch_size=4,
+                path=f.name,
+            )
+            ds = data_lib.make_dataset(kind, **cfg.dataset_kwargs())
+            assert ds.batch_size == 4
+            if hasattr(ds, "vocab_size"):
+                assert ds.vocab_size == 512
+            ds.batch(0)  # constructible and indexable
 
 
 def test_config_json_roundtrippable():

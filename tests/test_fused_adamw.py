@@ -50,6 +50,18 @@ def test_matches_optax_adamw(wd):
         )
 
 
+def test_tuple_pytree():
+    """Params trees containing tuples must unzip by structure, not type."""
+    params = {"pair": (jnp.ones((16, 128)), jnp.ones((4,)))}
+    tx = fused_adamw(1e-2)
+    state = tx.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    du, state = tx.update(g, state, params)
+    p = optax.apply_updates(params, du)
+    assert p["pair"][0].shape == (16, 128)
+    assert float(p["pair"][0][0, 0]) < 1.0  # moved against the gradient
+
+
 def test_schedule_and_jit():
     sched = optax.linear_schedule(1e-2, 0.0, 10)
     params = {"w": jnp.ones((16, 128))}
