@@ -16,9 +16,9 @@ import jax
 from . import data as data_lib
 from . import models
 from .config import Config, apply_overrides, load_config
-from .mesh import build_mesh
+from .mesh import build_mesh, init_distributed
 from .metrics import MetricWriter, Profiler
-from .train import Trainer, fit, get_task, make_optimizer
+from .train import Trainer, fit, get_task, make_optimizer, parse_fault_injection
 from .utils.pytree import tree_size
 
 
@@ -65,6 +65,10 @@ def build_all(cfg: Config):
 
 
 def cmd_train(cfg: Config) -> int:
+    if cfg.train.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+    if cfg.train.debug_checks:
+        jax.config.update("jax_enable_checks", True)
     mesh, _, trainer, dataset = build_all(cfg)
     print(f"devices: {jax.device_count()}  mesh: {dict(mesh.shape)}")
 
@@ -105,6 +109,7 @@ def cmd_train(cfg: Config) -> int:
             profiler=profiler,
             ckpt=ckpt,
             save_every=cfg.train.save_every,
+            fault_step=parse_fault_injection(cfg.train.fault_injection),
         )
     finally:
         # Always drain the async checkpoint queue — an abandoned in-flight
@@ -130,6 +135,9 @@ def main(argv=None) -> int:
             help="dotted config override (repeatable)",
         )
     args = parser.parse_args(argv)
+    # Multi-host rendezvous (no-op single-process); must precede any
+    # backend/device use.
+    init_distributed()
     cfg = apply_overrides(load_config(args.config), args.override)
     if args.cmd == "train":
         return cmd_train(cfg)

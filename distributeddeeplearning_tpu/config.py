@@ -81,6 +81,11 @@ class TrainConfig:
     eval_every: int = 0
     log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
+    # Debug/fault tooling (SURVEY §5): the XLA-world equivalents of the
+    # reference's CUDA sanitizer hooks.
+    fault_injection: str = ""  # "step:K" -> hard-kill the process at step K
+    debug_nans: bool = False  # jax_debug_nans: fail fast on NaN outputs
+    debug_checks: bool = False  # jax_enable_checks: internal invariants
 
 
 @dataclasses.dataclass(frozen=True)

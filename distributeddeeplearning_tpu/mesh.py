@@ -141,6 +141,67 @@ def _warn_topology_fallback(e: Exception) -> None:
         )
 
 
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Multi-host rendezvous — the reference's NCCL unique-id exchange.
+
+    Thin wrapper over ``jax.distributed.initialize`` (one process per host,
+    coordinator-based): explicit args win, else the standard env vars
+    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``, as used by
+    jax itself) or cluster auto-detection. Returns True when a multi-process
+    runtime was initialized, False for the single-process fast path. The
+    coordinator doubles as the failure detector: a process that misses
+    heartbeats is declared dead and the whole job exits for the restart-based
+    recovery flow (SURVEY §5: relaunch + orbax resume).
+    """
+    import os
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    if coordinator_address is None and num_processes in (None, 1):
+        # No explicit config: fall through to jax's cluster auto-detection
+        # (TPU pod metadata, SLURM, ...) when its markers are present —
+        # otherwise a pod launch would silently train as N independent
+        # single-process jobs. Plain single-host runs skip rendezvous.
+        multi_host = (
+            # >1 worker in the TPU pod metadata (a single name — as the
+            # local PJRT plugin sets — is not a cluster).
+            len(os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1
+            or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+            or "SLURM_JOB_ID" in os.environ
+            or "OMPI_COMM_WORLD_SIZE" in os.environ
+        )
+        if not multi_host:
+            return False
+        try:
+            jax.distributed.initialize()  # cluster auto-detection
+        except Exception as e:
+            warnings.warn(
+                f"multi-host markers present but cluster auto-detection "
+                f"failed ({type(e).__name__}: {e}); continuing "
+                "single-process — set COORDINATOR_ADDRESS/NUM_PROCESSES/"
+                "PROCESS_ID explicitly for multi-host training",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return False
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
 def single_device_mesh(device=None) -> Mesh:
     """All-axes-size-1 mesh on one device (the unsharded baseline for parity
     tests and the single-chip path)."""

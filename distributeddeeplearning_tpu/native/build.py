@@ -28,13 +28,21 @@ def _build(name: str) -> str | None:
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     cxx = os.environ.get("CXX", "g++")
+    # Compile to a process-unique temp path, then atomically publish: two
+    # processes racing on a fresh checkout must never leave a half-written
+    # .so at the cached path (mtime would suppress every future rebuild).
+    tmp = f"{out}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            [cxx, *_CXX_FLAGS, src, "-o", out],
+            [cxx, *_CXX_FLAGS, src, "-o", tmp],
             check=True, capture_output=True, text=True, timeout=120,
         )
+        os.replace(tmp, out)
     except (OSError, subprocess.SubprocessError):
         return None
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return out
 
 

@@ -95,9 +95,11 @@ class Loader {
       int64_t size = std::ftell(f);
       std::fseek(f, 0, SEEK_SET);
       num_records_ = size / cfg_.record_bytes;
-      if (num_records_ <= 0) {
+      if (num_records_ <= 0 || size % cfg_.record_bytes != 0) {
+        // Misaligned size means a wrong record_bytes config: truncating
+        // would silently misalign every record boundary.
         std::fclose(f);
-        throw std::runtime_error("no records in " + cfg_.path);
+        throw std::runtime_error("bad record file " + cfg_.path);
       }
       file_.resize(static_cast<size_t>(num_records_) * cfg_.record_bytes);
       if (std::fread(file_.data(), 1, file_.size(), f) != file_.size()) {
@@ -216,11 +218,24 @@ class Loader {
         labels[i] = static_cast<int32_t>(rng.below(cfg_.num_classes));
     } else {
       int64_t payload = cfg_.record_bytes - cfg_.label_bytes;
+      // A batch touches at most two consecutive epochs; fetch their
+      // permutations once (two lock acquisitions) instead of per sample.
+      int64_t first_epoch = (index * cfg_.batch) / num_records_;
+      int64_t last_epoch =
+          (index * cfg_.batch + cfg_.batch - 1) / num_records_;
+      std::shared_ptr<const std::vector<int32_t>> perm_a, perm_b;
+      if (cfg_.shuffle) {
+        perm_a = GetPerm(first_epoch);
+        perm_b = last_epoch == first_epoch ? perm_a : GetPerm(last_epoch);
+      }
       for (int64_t i = 0; i < cfg_.batch; ++i) {
         int64_t global = index * cfg_.batch + i;
         int64_t epoch = global / num_records_;
         int64_t pos = global % num_records_;
-        int64_t rec = cfg_.shuffle ? Permuted(epoch, pos) : pos;
+        int64_t rec =
+            cfg_.shuffle
+                ? (*(epoch == first_epoch ? perm_a : perm_b))[pos]
+                : pos;
         const uint8_t* p = file_.data() + rec * cfg_.record_bytes;
         int64_t label = 0;
         for (int64_t b = 0; b < cfg_.label_bytes; ++b)
@@ -234,23 +249,22 @@ class Loader {
     }
   }
 
-  // Element `pos` of the epoch's Fisher-Yates permutation. Permutations
-  // are cached per epoch (training touches epochs in order; the cache
-  // keeps the two neighbouring epochs a batch straddle can touch).
-  int64_t Permuted(int64_t epoch, int64_t pos) {
+  // The epoch's Fisher-Yates permutation, cached. shared_ptr so a caller
+  // can keep indexing lock-free while another thread prunes the cache.
+  std::shared_ptr<const std::vector<int32_t>> GetPerm(int64_t epoch) {
     std::lock_guard<std::mutex> lk(perm_m_);
     auto it = perms_.find(epoch);
     if (it == perms_.end()) {
-      std::vector<int32_t> perm(num_records_);
-      std::iota(perm.begin(), perm.end(), 0);
+      auto perm = std::make_shared<std::vector<int32_t>>(num_records_);
+      std::iota(perm->begin(), perm->end(), 0);
       Rng rng(splitmix64(cfg_.seed ^ 0xda7a5e7ull) ^
               static_cast<uint64_t>(epoch));
       for (int64_t i = num_records_ - 1; i > 0; --i)
-        std::swap(perm[i], perm[rng.below(i + 1)]);
-      if (perms_.size() > 2) perms_.clear();
+        std::swap((*perm)[i], (*perm)[rng.below(i + 1)]);
+      if (perms_.size() > 4) perms_.clear();
       it = perms_.emplace(epoch, std::move(perm)).first;
     }
-    return it->second[pos];
+    return it->second;
   }
 
   Config cfg_;
@@ -263,7 +277,8 @@ class Loader {
   int64_t next_out_ = 0;
   int64_t start_ = 0;
   std::mutex perm_m_;
-  std::unordered_map<int64_t, std::vector<int32_t>> perms_;
+  std::unordered_map<int64_t, std::shared_ptr<const std::vector<int32_t>>>
+      perms_;
 };
 
 }  // namespace

@@ -241,10 +241,7 @@ class RecordFileImages:
         for b in range(self.label_bytes):
             label |= labels[:, b] << (8 * b)
         data = recs[:, self.label_bytes :].astype(np.float32) / 255.0
-        return {
-            "image": _as_image(data, self.image_size, self.channels, self.layout),
-            "label": label,
-        }
+        return self._pack(data, label)
 
     def _pack(self, data, labels):
         return {

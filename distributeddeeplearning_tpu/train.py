@@ -405,6 +405,16 @@ class Trainer:
         return self._eval_step
 
 
+def parse_fault_injection(spec: str) -> int | None:
+    """'step:K' -> K; '' -> None."""
+    if not spec:
+        return None
+    kind, _, arg = spec.partition(":")
+    if kind != "step" or not arg.isdigit():
+        raise ValueError(f"fault_injection {spec!r}: expected 'step:K'")
+    return int(arg)
+
+
 def fit(
     trainer: Trainer,
     state: TrainState,
@@ -416,18 +426,29 @@ def fit(
     profiler=None,
     ckpt=None,
     save_every: int = 0,
+    fault_step: int | None = None,
 ) -> tuple[TrainState, list[dict]]:
     """Host step loop.
 
     Resumes from ``state.step`` (callers align ``batches`` to the same
     index). Metrics are pulled to host only every ``log_every`` steps;
-    checkpoint saves are async and off the loop.
+    checkpoint saves are async and off the loop. ``fault_step`` hard-kills
+    the process (no cleanup, simulating a crash) before running that step —
+    the test hook for the restart-based recovery flow (SURVEY §5): relaunch
+    resumes from the last durable orbax checkpoint.
     """
+    import os
+    import sys
+
     history = []
     start = int(state.step)
     t0 = time.perf_counter()
     it = iter(batches)
     for i in range(start, steps):
+        if fault_step is not None and i == fault_step:
+            print(f"fault injection: killing process before step {i}")
+            sys.stdout.flush()
+            os._exit(17)  # crash semantics: no atexit, no async-save drain
         try:
             batch = next(it)
         except StopIteration:
