@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Driver benchmark entry point — prints ONE JSON line.
+"""Driver benchmark entry point — ALWAYS prints exactly ONE JSON line.
 
 North-star workload (``BASELINE.json:2``): ResNet-50 / synthetic-ImageNet
 images/sec/chip, bf16 compute, data-parallel over every available device
@@ -7,19 +7,92 @@ images/sec/chip, bf16 compute, data-parallel over every available device
 the committed round-1 measurement in ``BENCH_BASELINE.json`` — the reference
 itself publishes no numbers (``BASELINE.json:13``).
 
-On a CPU-only host (no TPU attached) the same harness runs a reduced config
-so the line is still produced; the record is labeled with the platform.
+Hardening (round-2, VERDICT.md Weak #1): the round-1 run produced NO number
+because ``jax.default_backend()`` was called in this process and the axon
+PJRT plugin either raised or HUNG during init — the CPU fallback was
+unreachable. This process therefore never imports jax at all:
+
+  * backend availability is probed in a short-lived SUBPROCESS with a hard
+    timeout (a wedged plugin hangs rather than raises — observed live);
+  * the measurement itself runs in a child process (``--child tpu|cpu``);
+  * any TPU-path failure (nonzero rc, timeout, unparseable output) falls
+    back to a CPU child with a scrubbed env (``PALLAS_AXON_POOL_IPS`` unset
+    so the sitecustomize hook cannot re-register the axon backend,
+    ``JAX_PLATFORMS=cpu`` — the recipe verified in SURVEY.md §4);
+  * if even that fails, a JSON line with ``value: 0`` and the error tail is
+    printed. The driver contract (one JSON line, rc=0) holds in every case.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 
-import jax
+PROBE_TIMEOUT_S = 180  # axon first-init is ~20-40s healthy; wedged = hang
+TPU_BENCH_TIMEOUT_S = 1500
+CPU_BENCH_TIMEOUT_S = 900
+
+_PROBE_SRC = (
+    "import jax; jax.jit(lambda x: x + 1)(1).block_until_ready(); "
+    "print('BACKEND=' + jax.default_backend())"
+)
 
 
-def main() -> int:
+def _scrubbed_cpu_env() -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize trigger
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _probe_backend() -> str:
+    """Name of a *working* default backend, or 'cpu' if the accelerator is
+    unreachable/wedged. Runs in a subprocess so a hang cannot propagate."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return "cpu"
+    if out.returncode != 0:
+        return "cpu"
+    for line in out.stdout.splitlines():
+        if line.startswith("BACKEND="):
+            return line.split("=", 1)[1].strip()
+    return "cpu"
+
+
+def _run_child(mode: str) -> dict | None:
+    """Run the measurement child; return its parsed record or None."""
+    env = dict(os.environ) if mode == "tpu" else _scrubbed_cpu_env()
+    timeout = TPU_BENCH_TIMEOUT_S if mode == "tpu" else CPU_BENCH_TIMEOUT_S
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", mode],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        sys.stderr.write(out.stderr[-2000:])
+        return None
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def _child_main(mode: str) -> int:
+    """Measurement process. jax is imported only here."""
+    import jax  # noqa: deferred so the parent stays jax-free
+
     from distributeddeeplearning_tpu.benchmark import run_benchmark, vs_baseline
     from distributeddeeplearning_tpu.config import (
         Config,
@@ -30,8 +103,7 @@ def main() -> int:
         TrainConfig,
     )
 
-    on_accel = jax.default_backend() != "cpu"
-    if on_accel:
+    if mode == "tpu":
         cfg = Config(
             model=ModelConfig(
                 name="resnet50", kwargs={"num_classes": 1000, "dtype": "bfloat16"}
@@ -45,6 +117,7 @@ def main() -> int:
             mesh=MeshConfig(dp=-1),
         )
         warmup, steps = 5, 30
+        metric = "resnet50_imagenet_images_per_sec_per_chip"
     else:  # CPU fallback: tiny ResNet-18 so the harness still emits a line.
         cfg = Config(
             model=ModelConfig(name="resnet18", kwargs={"num_classes": 10}),
@@ -54,12 +127,8 @@ def main() -> int:
             mesh=MeshConfig(dp=-1),
         )
         warmup, steps = 2, 10
+        metric = "resnet18_cifar10_cpu_images_per_sec_per_chip"
 
-    metric = (
-        "resnet50_imagenet_images_per_sec_per_chip"
-        if on_accel
-        else "resnet18_cifar10_cpu_images_per_sec_per_chip"
-    )
     record = run_benchmark(cfg, warmup=warmup, steps=steps)
     out = {
         "metric": metric,
@@ -70,9 +139,38 @@ def main() -> int:
         "device_count": record["device_count"],
         "steps_per_sec": record["steps_per_sec"],
     }
+    for key in ("model_tflops_per_step", "achieved_tflops_per_sec", "mfu"):
+        if key in record:
+            out[key] = record[key]
     print(json.dumps(out))
     return 0
 
 
+def main() -> int:
+    backend = _probe_backend()
+    record = None
+    if backend != "cpu":
+        record = _run_child("tpu")
+        if record is None:
+            sys.stderr.write(
+                "bench.py: TPU child failed/timed out; falling back to CPU\n"
+            )
+    if record is None:
+        record = _run_child("cpu")
+    if record is None:
+        record = {
+            "metric": "resnet50_imagenet_images_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "platform": "none",
+            "error": "both TPU and CPU benchmark children failed",
+        }
+    print(json.dumps(record))
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        sys.exit(_child_main(sys.argv[2]))
     sys.exit(main())

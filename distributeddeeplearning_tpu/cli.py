@@ -22,8 +22,12 @@ from .train import Trainer, fit, get_task, make_optimizer, parse_fault_injection
 from .utils.pytree import tree_size
 
 
-def build_all(cfg: Config):
-    """Construct (mesh, model, trainer, dataset) from a config."""
+def build_all(cfg: Config, split: str = "train"):
+    """Construct (mesh, model, trainer, dataset) from a config.
+
+    ``split='eval'`` builds the dataset from the eval-split kwargs instead —
+    used by ``cmd_eval`` so a standalone eval doesn't also load the training
+    data (for record-file kinds that would hold the file in memory twice)."""
     mesh = build_mesh(cfg.mesh)
     model = models.get_model(cfg.model.name, **cfg.model.kwargs)
     # Mesh-aware models (ring/Ulysses attention, pipelined stacks) need the
@@ -60,8 +64,55 @@ def build_all(cfg: Config):
         grad_accum=cfg.train.grad_accum,
         zero1=cfg.train.zero1,
     )
-    dataset = data_lib.make_dataset(cfg.data.kind, **cfg.data.dataset_kwargs())
+    data_kwargs = (
+        cfg.data.eval_dataset_kwargs() if split == "eval"
+        else cfg.data.dataset_kwargs()
+    )
+    dataset = data_lib.make_dataset(cfg.data.kind, **data_kwargs)
     return mesh, model, trainer, dataset
+
+
+def make_eval_fn(cfg: Config, mesh, dataset=None):
+    """Callable returning a fresh iterable of sharded eval-split batches —
+    what ``fit(eval_fn=...)`` and ``cmd_eval`` consume. ``dataset`` reuses an
+    already-built eval dataset instead of constructing a second one."""
+    import itertools
+
+    eval_ds = dataset if dataset is not None else data_lib.make_dataset(
+        cfg.data.kind, **cfg.data.eval_dataset_kwargs()
+    )
+
+    def eval_batches():
+        it = itertools.islice(eval_ds.iter_from(0), cfg.train.eval_batches)
+        return data_lib.sharded_batches(it, mesh)
+
+    return eval_batches
+
+
+def cmd_eval(cfg: Config) -> int:
+    """Standalone evaluation: restore the latest checkpoint (or init fresh
+    when none exists) and report mean eval metrics — top-1 ``eval_accuracy``
+    for the vision tasks (``BASELINE.json:2`` "top-1 parity")."""
+    from .train import evaluate
+
+    mesh, _, trainer, eval_ds = build_all(cfg, split="eval")
+    state = None
+    if cfg.train.checkpoint_dir:
+        from .checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+        if ckpt.latest_step() is not None:
+            trainer.setup(eval_ds.batch(0))
+            state, _ = ckpt.restore(trainer.abstract_state_with_shardings())
+            print(f"evaluating checkpoint at step {int(state.step)}")
+        ckpt.close()
+    if state is None:
+        print("no checkpoint found — evaluating freshly initialized params")
+        state = trainer.init(cfg.train.seed, eval_ds.batch(0))
+    metrics = evaluate(trainer, state, make_eval_fn(cfg, mesh, dataset=eval_ds)())
+    metrics["step"] = int(state.step)
+    print(json.dumps(metrics))
+    return 0
 
 
 def cmd_train(cfg: Config) -> int:
@@ -110,6 +161,8 @@ def cmd_train(cfg: Config) -> int:
             ckpt=ckpt,
             save_every=cfg.train.save_every,
             fault_step=parse_fault_injection(cfg.train.fault_injection),
+            eval_every=cfg.train.eval_every,
+            eval_fn=make_eval_fn(cfg, mesh) if cfg.train.eval_every else None,
         )
     finally:
         # Always drain the async checkpoint queue — an abandoned in-flight
@@ -124,7 +177,7 @@ def cmd_train(cfg: Config) -> int:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="distributeddeeplearning_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("train", "benchmark"):
+    for name in ("train", "eval", "benchmark"):
         p = sub.add_parser(name)
         p.add_argument("--config", required=True, help="path to a config .py")
         p.add_argument(
@@ -141,6 +194,8 @@ def main(argv=None) -> int:
     cfg = apply_overrides(load_config(args.config), args.override)
     if args.cmd == "train":
         return cmd_train(cfg)
+    if args.cmd == "eval":
+        return cmd_eval(cfg)
     if args.cmd == "benchmark":
         try:
             from .benchmark import run_benchmark

@@ -34,6 +34,11 @@ class DataConfig:
     vocab_size: int = 1024
     n_distinct: int = 8
     seed: int = 0
+    # Held-out eval split: a different generator seed for the synthetic
+    # kinds. -1 = eval on the training distribution (the right choice for the
+    # memorization-style synthetic tests, where "held-out" random noise is
+    # unlearnable by construction).
+    eval_seed: int = -1
     path: str = ""  # record_file_image: binary record file
     num_threads: int = 2  # native loader worker threads
     prefetch_depth: int = 4  # native loader ring depth
@@ -52,6 +57,14 @@ class DataConfig:
             for k in cls_fields
             if k != "kind" and hasattr(self, k)
         }
+
+    def eval_dataset_kwargs(self) -> dict[str, Any]:
+        """Same as :meth:`dataset_kwargs` but on the eval split (see
+        ``eval_seed``)."""
+        kwargs = self.dataset_kwargs()
+        if self.eval_seed >= 0 and "seed" in kwargs:
+            kwargs["seed"] = self.eval_seed
+        return kwargs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,7 +91,8 @@ class TrainConfig:
     zero1: bool = False  # ZeRO-1 optimizer-state sharding (M2)
     checkpoint_dir: str = ""
     save_every: int = 0
-    eval_every: int = 0
+    eval_every: int = 0  # run the eval loop every K steps (0 = off)
+    eval_batches: int = 8  # batches per eval pass
     log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
     # Debug/fault tooling (SURVEY §5): the XLA-world equivalents of the

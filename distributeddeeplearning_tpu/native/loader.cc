@@ -139,15 +139,23 @@ class Loader {
   }
 
   // Copy the next batch (in index order) into caller buffers.
-  // Returns the batch index.
+  // Returns the batch index, or -1 if Stop() interrupted the wait (so a
+  // consumer blocked here cannot deadlock a concurrent Stop()/destructor).
   int64_t Next(float* data, int32_t* labels) {
     int64_t want = next_out_++;
     Slot& slot = *slots_[want % slots_.size()];
     {
       std::unique_lock<std::mutex> lk(slot.m);
       slot.cv.wait(lk, [&] {
-        return slot.index.load(std::memory_order_acquire) == want;
+        return stop_.load(std::memory_order_relaxed) ||
+               slot.index.load(std::memory_order_acquire) == want;
       });
+      if (slot.index.load(std::memory_order_acquire) != want) {
+        // Stopped: the stream is dead until the next Start() (which resets
+        // next_out_, so no rollback here — a rollback would race Start()'s
+        // reset from another thread).
+        return -1;
+      }
       std::memcpy(data, slot.data.data(), slot.data.size() * sizeof(float));
       std::memcpy(labels, slot.labels.data(),
                   slot.labels.size() * sizeof(int32_t));

@@ -26,6 +26,60 @@ import numpy as np
 from .build import load_library
 
 
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """Exact port of loader.cc's splitmix64 (same constants, 64-bit wrap)."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+class _Xoshiro256pp:
+    """Exact port of loader.cc's xoshiro256++ — the numpy fallback must
+    produce the SAME per-epoch shuffle as the native path, or resume order
+    silently depends on whether a C++ toolchain was present (ADVICE.md r1)."""
+
+    def __init__(self, seed: int):
+        s = []
+        for _ in range(4):
+            seed = _splitmix64(seed)
+            s.append(seed)
+        self.s = s
+
+    @staticmethod
+    def _rotl(x: int, k: int) -> int:
+        return ((x << k) | (x >> (64 - k))) & _M64
+
+    def next(self) -> int:
+        s = self.s
+        result = (self._rotl((s[0] + s[3]) & _M64, 23) + s[0]) & _M64
+        t = (s[1] << 17) & _M64
+        s[2] ^= s[0]
+        s[3] ^= s[1]
+        s[1] ^= s[2]
+        s[0] ^= s[3]
+        s[2] ^= t
+        s[3] = self._rotl(s[3], 45)
+        return result
+
+    def below(self, n: int) -> int:
+        return self.next() % n
+
+
+def _native_epoch_perm(seed: int, epoch: int, n: int) -> np.ndarray:
+    """The per-epoch Fisher-Yates permutation exactly as loader.cc GetPerm
+    computes it (same seeding and same swap sequence)."""
+    rng = _Xoshiro256pp(_splitmix64((seed ^ 0xDA7A5E7) & _M64) ^ epoch)
+    perm = np.arange(n, dtype=np.int64)
+    for i in range(n - 1, 0, -1):
+        j = rng.below(i + 1)
+        perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     f32p = ctypes.POINTER(ctypes.c_float)
     i32p = ctypes.POINTER(ctypes.c_int32)
@@ -166,7 +220,13 @@ class NativeSyntheticImages:
                     "create a separate dataset for concurrent iteration"
                 )
             data, labels = self._buffers()
-            self._h.next(data, labels)
+            if self._h.next(data, labels) < 0:
+                # Stop() interrupted the wait (superseding iter_from or
+                # shutdown): the buffers were never written — fail loudly
+                # instead of yielding uninitialized memory as a batch.
+                raise RuntimeError(
+                    "native loader stream stopped (superseded or shutting down)"
+                )
             yield self._pack(data, labels)
 
     def __iter__(self):
@@ -221,9 +281,11 @@ class RecordFileImages:
         if epoch not in self._perm_cache:
             if len(self._perm_cache) > 2:  # a batch straddles <= 2 epochs
                 self._perm_cache.clear()
-            self._perm_cache[epoch] = np.random.default_rng(
-                (self.seed << 16) ^ epoch
-            ).permutation(len(self._np))
+            # Same permutation as the native path (loader.cc GetPerm), so
+            # batch order is environment-independent.
+            self._perm_cache[epoch] = _native_epoch_perm(
+                self.seed, epoch, len(self._np)
+            )
         return self._perm_cache[epoch]
 
     def _fallback_batch(self, index: int):
@@ -273,7 +335,10 @@ class RecordFileImages:
                 )
             data = np.empty((self.batch_size, self._sample), np.float32)
             labels = np.empty((self.batch_size,), np.int32)
-            self._h.next(data, labels)
+            if self._h.next(data, labels) < 0:
+                raise RuntimeError(
+                    "native loader stream stopped (superseded or shutting down)"
+                )
             yield self._pack(data, labels)
 
     def __iter__(self):

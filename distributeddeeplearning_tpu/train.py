@@ -415,6 +415,23 @@ def parse_fault_injection(spec: str) -> int | None:
     return int(arg)
 
 
+def evaluate(trainer: Trainer, state: TrainState, batches) -> dict[str, float]:
+    """Run ``eval_step`` over an iterable of (sharded) batches and return the
+    batch-mean of every metric. The vision tasks report top-1 ``accuracy``
+    here — the parity half of the north-star metric (``BASELINE.json:2``:
+    "top-1 parity at 90 epochs")."""
+    sums: dict[str, float] = {}
+    count = 0
+    for batch in batches:
+        metrics = trainer.eval_step(state, batch)
+        for k, v in metrics.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+        count += 1
+    if count == 0:
+        raise ValueError("evaluate() got an empty batch iterable")
+    return {f"eval_{k}": v / count for k, v in sums.items()}
+
+
 def fit(
     trainer: Trainer,
     state: TrainState,
@@ -427,6 +444,8 @@ def fit(
     ckpt=None,
     save_every: int = 0,
     fault_step: int | None = None,
+    eval_every: int = 0,
+    eval_fn=None,
 ) -> tuple[TrainState, list[dict]]:
     """Host step loop.
 
@@ -436,14 +455,31 @@ def fit(
     the process (no cleanup, simulating a crash) before running that step —
     the test hook for the restart-based recovery flow (SURVEY §5): relaunch
     resumes from the last durable orbax checkpoint.
+
+    ``eval_every`` > 0 runs :func:`evaluate` over ``eval_fn()`` (a callable
+    returning a fresh iterable of sharded eval batches) every that many
+    steps and after the final step; eval metrics join the history/TB stream
+    prefixed ``eval_``.
     """
     import os
     import sys
+
+    if eval_every and eval_fn is None:
+        raise ValueError("eval_every > 0 requires eval_fn")
+
+    def run_eval(i):
+        m = evaluate(trainer, state, eval_fn())
+        m["step"] = i + 1
+        history.append(m)
+        log_fn(m)
+        if writer is not None:
+            writer.write(i + 1, {k: v for k, v in m.items() if k != "step"})
 
     history = []
     start = int(state.step)
     t0 = time.perf_counter()
     it = iter(batches)
+    i = start - 1
     for i in range(start, steps):
         if fault_step is not None and i == fault_step:
             print(f"fault injection: killing process before step {i}")
@@ -464,8 +500,19 @@ def fit(
             log_fn(m)
             if writer is not None:
                 writer.write(i + 1, {k: v for k, v in m.items() if k != "step"})
+        if eval_every and (i + 1) % eval_every == 0:
+            run_eval(i)
         if ckpt is not None and save_every and (i + 1) % save_every == 0:
             ckpt.save(i + 1, state, {"next_index": i + 1})
+            if fault_step is not None:
+                # Fault injection simulates a crash at an arbitrary step; the
+                # recovery contract is "resume from the last DURABLE save".
+                # Draining here makes every completed save durable, so the
+                # crash→resume test is deterministic instead of racing the
+                # async writer (ADVICE.md r1).
+                ckpt.wait()
+    if eval_every and (i + 1) % eval_every != 0 and i >= start:
+        run_eval(i)  # final eval so short runs still report one
     if profiler is not None:
         profiler.close()
     if writer is not None:

@@ -1,0 +1,136 @@
+"""Evaluation path (VERDICT.md round-1 missing #3): ``eval_every`` drives a
+real eval loop inside ``fit``, ``evaluate`` reports top-1 accuracy for the
+vision tasks (``BASELINE.json:2`` "top-1 parity"), and the ``eval`` CLI
+subcommand works standalone.
+"""
+
+import json
+
+import pytest
+
+from distributeddeeplearning_tpu import data as data_lib
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.cli import cmd_eval, cmd_train, make_eval_fn
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.train import (
+    Trainer,
+    evaluate,
+    fit,
+    get_task,
+    make_optimizer,
+)
+
+
+def _trainer_and_data(mesh, batch_size=32):
+    model = models.get_model("resnet18", num_classes=10, width=8)
+    trainer = Trainer(
+        model, make_optimizer("sgd", 0.05, momentum=0.9),
+        get_task("classification"), mesh, donate=False,
+    )
+    ds = data_lib.SyntheticImages(
+        batch_size=batch_size, image_size=16, num_classes=10, n_distinct=4
+    )
+    return trainer, ds
+
+
+def test_evaluate_reports_top1_accuracy(mesh8):
+    import itertools
+
+    trainer, ds = _trainer_and_data(mesh8)
+    state = trainer.init(0, ds.batch(0))
+    batches = data_lib.sharded_batches(
+        itertools.islice(ds.iter_from(0), 4), mesh8
+    )
+    metrics = evaluate(trainer, state, batches)
+    assert set(metrics) >= {"eval_loss", "eval_accuracy"}
+    assert 0.0 <= metrics["eval_accuracy"] <= 1.0
+
+
+def test_eval_accuracy_rises_during_fit(mesh8):
+    # Memorizable set (n_distinct=4): training must drive eval accuracy up.
+    trainer, ds = _trainer_and_data(mesh8)
+    state = trainer.init(0, ds.batch(0))
+
+    def eval_fn():
+        it = ds.iter_from(0)
+        return data_lib.sharded_batches(
+            (next(it) for _ in range(4)), mesh8
+        )
+
+    _, history = fit(
+        trainer, state, data_lib.sharded_batches(ds.iter_from(0), mesh8),
+        steps=24, log_every=0, eval_every=8, eval_fn=eval_fn,
+    )
+    evals = [h for h in history if "eval_accuracy" in h]
+    assert len(evals) == 3, history
+    assert evals[-1]["eval_accuracy"] > evals[0]["eval_accuracy"], evals
+    assert evals[-1]["eval_loss"] < evals[0]["eval_loss"], evals
+
+
+def test_fit_rejects_eval_every_without_eval_fn(mesh8):
+    trainer, ds = _trainer_and_data(mesh8)
+    state = trainer.init(0, ds.batch(0))
+    with pytest.raises(ValueError, match="eval_fn"):
+        fit(
+            trainer, state,
+            data_lib.sharded_batches(ds.iter_from(0), mesh8),
+            steps=2, eval_every=1,
+        )
+
+
+def _tiny_cfg(**train_kw):
+    return Config(
+        model=ModelConfig(name="resnet18", kwargs={"num_classes": 10, "width": 8}),
+        data=DataConfig(
+            kind="synthetic_image", batch_size=16, image_size=16, n_distinct=4
+        ),
+        optim=OptimConfig(name="sgd", lr=0.05),
+        train=TrainConfig(task="classification", **train_kw),
+    )
+
+
+def test_cmd_train_emits_eval_lines(capsys):
+    cfg = _tiny_cfg(steps=4, log_every=0, eval_every=2, eval_batches=2)
+    assert cmd_train(cfg) == 0
+    evals = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{") and "eval_accuracy" in line
+    ]
+    assert len(evals) == 2 and all("eval_loss" in e for e in evals)
+
+
+def test_cmd_eval_standalone(capsys):
+    cfg = _tiny_cfg(steps=0, eval_batches=2)
+    assert cmd_eval(cfg) == 0
+    lines = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    assert any("eval_accuracy" in m for m in lines)
+
+
+def test_eval_seed_selects_heldout_split(mesh8):
+    cfg = _tiny_cfg()
+    cfg = Config(
+        model=cfg.model,
+        data=DataConfig(
+            kind="synthetic_image", batch_size=16, image_size=16,
+            n_distinct=4, eval_seed=123,
+        ),
+        optim=cfg.optim,
+        train=cfg.train,
+    )
+    train_kw = cfg.data.dataset_kwargs()
+    eval_kw = cfg.data.eval_dataset_kwargs()
+    assert train_kw["seed"] == 0 and eval_kw["seed"] == 123
+    ds_a = data_lib.make_dataset(cfg.data.kind, **train_kw)
+    ds_b = data_lib.make_dataset(cfg.data.kind, **eval_kw)
+    assert not (ds_a.batch(0)["image"] == ds_b.batch(0)["image"]).all()

@@ -115,6 +115,30 @@ def test_record_file_shuffle_epochs(tmp_path):
     np.testing.assert_array_equal(next(it)["label"], ds.batch(3)["label"])
 
 
+@needs_native
+def test_fallback_shuffle_matches_native(tmp_path, monkeypatch):
+    """The numpy fallback must yield the SAME shuffled batch order as the
+    C++ path (ADVICE.md r1: it used a different RNG, silently breaking
+    cross-environment reproducibility). The fallback now ports the exact
+    splitmix64/xoshiro Fisher-Yates from loader.cc."""
+    from distributeddeeplearning_tpu.native import loader as loader_mod
+
+    path = str(tmp_path / "train.bin")
+    _write_records(path, n=40, size=4)
+    native_ds = RecordFileImages(
+        path=path, batch_size=8, image_size=4, shuffle=True, seed=5
+    )
+    monkeypatch.setattr(loader_mod, "_lib", lambda: None)
+    fallback_ds = RecordFileImages(
+        path=path, batch_size=8, image_size=4, shuffle=True, seed=5
+    )
+    assert fallback_ds._h is None and native_ds._h is not None
+    for i in (0, 3, 7):  # spans epochs 0 and 1
+        a, b = native_ds.batch(i), fallback_ds.batch(i)
+        np.testing.assert_array_equal(a["label"], b["label"], err_msg=str(i))
+        np.testing.assert_allclose(a["image"], b["image"], rtol=1e-6)
+
+
 def test_registered_in_dataset_kinds():
     from distributeddeeplearning_tpu.data import make_dataset
 
