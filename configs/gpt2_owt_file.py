@@ -1,0 +1,42 @@
+"""Workload 4, real-data variant (BASELINE.json:10): GPT-2 124M trained
+from an on-disk DDLTOK01 tokenized corpus (e.g. OpenWebText).
+
+Produce the file first:
+
+    python -m distributeddeeplearning_tpu.prepare_data \
+        --input openwebtext.txt --output owt.tok --tokenizer hf:gpt2
+
+then train with ``--override data.path=owt.tok``. Resume after a crash is
+step-exact (the checkpoint stores the batch index; batches are a pure
+function of (seed, index) — see tests/test_fault_tolerance.py).
+"""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(
+            name="gpt2", kwargs={"size": "124m", "max_len": 1024}
+        ),
+        data=DataConfig(
+            kind="token_file_lm", batch_size=32, seq_len=1024,
+            path="",  # required: --override data.path=<corpus.tok>
+        ),
+        optim=OptimConfig(
+            name="adamw", lr=6e-4, b2=0.95, weight_decay=0.1,
+            schedule="cosine", warmup_steps=200, grad_clip=1.0,
+        ),
+        train=TrainConfig(
+            steps=1000, log_every=20, task="lm", zero1=True,
+            save_every=200, checkpoint_dir="/tmp/gpt2_owt_ckpt",
+        ),
+        mesh=MeshConfig(dp=-1),
+    )

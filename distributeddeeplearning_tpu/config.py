@@ -32,14 +32,20 @@ class DataConfig:
     num_classes: int = 10
     seq_len: int = 128
     vocab_size: int = 1024
+    mask_prob: float = 0.15  # MLM kinds: fraction of positions masked
+    mask_token_id: int = 3  # MLM kinds: the [MASK] id
     n_distinct: int = 8
     seed: int = 0
-    # Held-out eval split: a different generator seed for the synthetic
-    # kinds. -1 = eval on the training distribution (the right choice for the
-    # memorization-style synthetic tests, where "held-out" random noise is
-    # unlearnable by construction).
+    # Held-out eval split. Synthetic kinds: ``eval_seed`` >= 0 draws eval
+    # batches from a different generator seed (-1 = eval on the training
+    # distribution — the right choice for the memorization-style synthetic
+    # tests, where "held-out" random noise is unlearnable by construction).
+    # File-backed kinds: ``eval_path`` points at a separate validation file;
+    # a seed swap alone would only RESHUFFLE the training file and silently
+    # report training loss as eval, so that combination is rejected.
     eval_seed: int = -1
-    path: str = ""  # record_file_image: binary record file
+    eval_path: str = ""
+    path: str = ""  # record_file_image / token_file_*: data file
     num_threads: int = 2  # native loader worker threads
     prefetch_depth: int = 4  # native loader ring depth
 
@@ -60,8 +66,18 @@ class DataConfig:
 
     def eval_dataset_kwargs(self) -> dict[str, Any]:
         """Same as :meth:`dataset_kwargs` but on the eval split (see
-        ``eval_seed``)."""
+        ``eval_seed`` / ``eval_path``)."""
         kwargs = self.dataset_kwargs()
+        if "path" in kwargs:  # file-backed kind
+            if self.eval_path:
+                kwargs["path"] = self.eval_path
+            elif self.eval_seed >= 0:
+                raise ValueError(
+                    f"data.eval_seed with file-backed kind {self.kind!r} only "
+                    "reshuffles the training file — set data.eval_path to a "
+                    "held-out file instead"
+                )
+            return kwargs
         if self.eval_seed >= 0 and "seed" in kwargs:
             kwargs["seed"] = self.eval_seed
         return kwargs

@@ -19,30 +19,14 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import importlib.util
 from collections.abc import Iterator
 
 import jax
 import numpy as np
 
+from .dataset_base import IndexedDataset  # noqa: F401  (re-export)
 from .sharding import batch_sharding
-
-
-class IndexedDataset:
-    """Base for datasets addressable by batch index: ``batch(i)`` is pure and
-    deterministic, which is what makes resume step-exact and parity tests
-    sharding-independent."""
-
-    def batch(self, index: int) -> dict[str, np.ndarray]:
-        raise NotImplementedError
-
-    def iter_from(self, start: int = 0) -> Iterator[dict[str, np.ndarray]]:
-        i = start
-        while True:
-            yield self.batch(i)
-            i += 1
-
-    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
-        return self.iter_from(0)
 
 
 @dataclasses.dataclass
@@ -154,6 +138,16 @@ try:
     DATASET_KINDS["record_file_image"] = RecordFileImages
 except ImportError:  # pragma: no cover
     pass
+
+# Tokenized-text file kinds (real-dataset path for the LM/MLM workloads).
+from .data_text import GrainTokenFileLM, TokenFileLM, TokenFileMLM  # noqa: E402
+
+DATASET_KINDS["token_file_lm"] = TokenFileLM
+DATASET_KINDS["token_file_mlm"] = TokenFileMLM
+# Grain-backed kind only where grain exists — an advertised-but-
+# unconstructible kind would fail at __post_init__ instead of lookup.
+if importlib.util.find_spec("grain") is not None:
+    DATASET_KINDS["grain_token_file_lm"] = GrainTokenFileLM
 
 
 def make_dataset(kind: str, **kwargs):

@@ -1,0 +1,250 @@
+"""Tokenized-text input pipeline — the real-dataset path for the LM/MLM
+workloads (``BASELINE.json:9-10`` name Wikipedia / OpenWebText; SURVEY §2d
+"Grain index-based, checkpointable; per-host file sharding").
+
+On-disk format (``DDLTOK01``): a 32-byte header (magic, version, token byte
+width, vocab size, token count) followed by a flat little-endian token
+stream. ``prepare_data.py`` produces it from raw text; GPT-2's 50257-token
+vocab fits uint16, so a tokenized OpenWebText shard is 2 bytes/token.
+
+Three dataset kinds, all index-addressable (``batch(i)`` is a pure function
+of ``(seed, i)``) so the trainer's step-exact crash-resume contract — the
+checkpoint stores only ``next_index`` — holds for file-backed data exactly
+as it does for synthetic data:
+
+- ``token_file_lm`` — mmap-backed causal-LM batches. The file is mapped,
+  not read: each host materializes only the pages its sequences touch, so
+  the multi-host global-batch contract (``data.sharded_batches`` slices the
+  global batch per process) does per-host file sharding for free.
+- ``token_file_mlm`` — same source with deterministic host-side BERT-style
+  masking (the data-collator approach, mirroring ``SyntheticMLM``).
+- ``grain_token_file_lm`` — the same stream through Grain's ``MapDataset``
+  (``source().seed().shuffle().repeat().batch()``): Grain owns the shuffle
+  and epoch accounting, and stays index-addressable because MapDataset is
+  random-access. Per-host sharded streaming with Grain-native checkpoint
+  state is :func:`grain_per_host_loader`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+from .dataset_base import IndexedDataset
+
+_MAGIC = b"DDLTOK01"
+_HEADER = struct.Struct("<8sIIQQ")  # magic, version, dtype bytes, vocab, count
+_VERSION = 1
+
+
+def write_token_file(path: str, tokens, vocab_size: int) -> None:
+    """Write a DDLTOK01 token file. Token width is chosen from vocab_size."""
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 1:
+        raise ValueError(f"tokens must be 1-D, got shape {tokens.shape}")
+    if vocab_size <= 0 or (len(tokens) and int(tokens.max()) >= vocab_size):
+        raise ValueError("tokens out of range for vocab_size")
+    dtype = np.uint16 if vocab_size <= 1 << 16 else np.uint32
+    with open(path, "wb") as f:
+        f.write(
+            _HEADER.pack(
+                _MAGIC, _VERSION, dtype().itemsize, vocab_size, len(tokens)
+            )
+        )
+        f.write(np.ascontiguousarray(tokens, dtype=dtype).tobytes())
+
+
+def read_token_file(path: str) -> tuple[np.memmap, int]:
+    """Memory-map a DDLTOK01 file -> (tokens, vocab_size)."""
+    with open(path, "rb") as f:
+        header = f.read(_HEADER.size)
+    if len(header) < _HEADER.size:
+        raise ValueError(f"{path}: truncated token-file header")
+    magic, version, itemsize, vocab, count = _HEADER.unpack(header)
+    if magic != _MAGIC or version != _VERSION:
+        raise ValueError(f"{path}: not a DDLTOK01 token file")
+    dtype = {2: np.uint16, 4: np.uint32}.get(itemsize)
+    if dtype is None:
+        raise ValueError(f"{path}: unsupported token width {itemsize}")
+    tokens = np.memmap(
+        path, dtype=dtype, mode="r", offset=_HEADER.size, shape=(count,)
+    )
+    return tokens, vocab
+
+
+class _TokenFileBase(IndexedDataset):
+    """Shared mmap + per-epoch-shuffle machinery.
+
+    The stream is chunked into ``n_seq`` non-overlapping sequences of
+    ``seq_len`` tokens (+1 lookahead token for the causal shift); each epoch
+    visits every sequence once in a seeded permutation; the trailing partial
+    batch of an epoch is dropped (classic drop-remainder semantics, keeping
+    batch shapes static for XLA)."""
+
+    def _setup(self, path: str, seq_len: int, batch_size: int):
+        if not path:
+            raise ValueError(f"{type(self).__name__} requires data.path")
+        self._tokens, self.vocab_size = read_token_file(path)
+        self._n_seq = (len(self._tokens) - 1) // seq_len
+        if self._n_seq < batch_size:
+            raise ValueError(
+                f"{path}: only {self._n_seq} sequences of length {seq_len}; "
+                f"need >= batch_size ({batch_size})"
+            )
+        self._batches_per_epoch = self._n_seq // batch_size
+        self._perm_cache: dict[int, np.ndarray] = {}
+
+    def _perm(self, epoch: int) -> np.ndarray:
+        if epoch not in self._perm_cache:
+            if len(self._perm_cache) > 2:
+                self._perm_cache.clear()
+            self._perm_cache[epoch] = np.random.default_rng(
+                (self.seed << 20) ^ epoch
+            ).permutation(self._n_seq)
+        return self._perm_cache[epoch]
+
+    def _sequences(self, index: int, extra: int) -> np.ndarray:
+        """[batch, seq_len + extra] int32 rows for global batch ``index``."""
+        epoch, k = divmod(index, self._batches_per_epoch)
+        rows = self._perm(epoch)[k * self.batch_size : (k + 1) * self.batch_size]
+        out = np.empty((self.batch_size, self.seq_len + extra), np.int32)
+        for b, j in enumerate(rows):
+            start = int(j) * self.seq_len
+            out[b] = self._tokens[start : start + self.seq_len + extra]
+        return out
+
+
+@dataclasses.dataclass
+class TokenFileLM(_TokenFileBase):
+    """Causal-LM batches from a DDLTOK01 file: ``{'tokens': [B, L+1]}``
+    (one lookahead token, matching ``SyntheticTokens``' contract)."""
+
+    path: str
+    batch_size: int
+    seq_len: int = 128
+    seed: int = 0
+
+    def __post_init__(self):
+        self._setup(self.path, self.seq_len, self.batch_size)
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        return {"tokens": self._sequences(index, extra=1)}
+
+
+@dataclasses.dataclass
+class TokenFileMLM(_TokenFileBase):
+    """BERT-style MLM batches from a DDLTOK01 file, masked host-side with a
+    ``(seed, index)``-deterministic pattern (resume-exact, like
+    ``SyntheticMLM``)."""
+
+    path: str
+    batch_size: int
+    seq_len: int = 128
+    mask_prob: float = 0.15
+    mask_token_id: int = 3
+    seed: int = 0
+
+    def __post_init__(self):
+        self._setup(self.path, self.seq_len, self.batch_size)
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        tokens = self._sequences(index, extra=0)
+        rng = np.random.default_rng((self.seed << 20) + 0x3A5C + index)
+        masked = rng.random(tokens.shape) < self.mask_prob
+        inputs = np.where(masked, np.int32(self.mask_token_id), tokens)
+        labels = np.where(masked, tokens, np.int32(-1))
+        return {"input_tokens": inputs, "labels": labels}
+
+
+class _GrainSeqSource:
+    """Grain RandomAccessDataSource view: sequence j of the token stream."""
+
+    def __init__(self, tokens: np.memmap, seq_len: int, n_seq: int):
+        self._tokens = tokens
+        self._seq_len = seq_len
+        self._n_seq = n_seq
+
+    def __len__(self) -> int:
+        return self._n_seq
+
+    def __getitem__(self, j: int) -> np.ndarray:
+        start = j * self._seq_len
+        return np.asarray(
+            self._tokens[start : start + self._seq_len + 1], np.int32
+        )
+
+
+@dataclasses.dataclass
+class GrainTokenFileLM(IndexedDataset):
+    """The same causal-LM stream through Grain's MapDataset.
+
+    Grain owns shuffling (reshuffled each epoch via its own counter-based
+    RNG) and batch assembly; the result stays a pure function of
+    ``(seed, index)`` because MapDataset is random-access — so resume,
+    parity tests, and the multi-host global-batch contract all work
+    unchanged. Epoch boundaries differ from ``TokenFileLM`` (Grain carries
+    the epoch remainder into the next batch instead of dropping it)."""
+
+    path: str
+    batch_size: int
+    seq_len: int = 128
+    seed: int = 0
+
+    def __post_init__(self):
+        import grain
+
+        tokens, self.vocab_size = read_token_file(self.path)
+        n_seq = (len(tokens) - 1) // self.seq_len
+        if n_seq < self.batch_size:
+            raise ValueError(
+                f"{self.path}: only {n_seq} sequences; need >= batch_size"
+            )
+        source = _GrainSeqSource(tokens, self.seq_len, n_seq)
+        self._ds = (
+            grain.MapDataset.source(source)
+            .seed(self.seed)
+            .shuffle()
+            .repeat()
+            .batch(self.batch_size)
+        )
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        return {"tokens": np.asarray(self._ds[index], np.int32)}
+
+
+def grain_per_host_loader(
+    path: str,
+    batch_size: int,
+    seq_len: int = 128,
+    seed: int = 0,
+    num_workers: int = 0,
+):
+    """Grain ``DataLoader`` yielding this process's LOCAL shard of the
+    stream (``ShardByJaxProcess``), with Grain-native checkpointable
+    iterator state (``it.get_state()`` / ``it.set_state()``).
+
+    This is the streaming alternative to the index-addressable kinds above:
+    instead of every host computing the global batch and contributing a
+    slice, each host reads only its own records. ``batch_size`` here is the
+    PER-HOST batch; combine with
+    ``jax.make_array_from_process_local_data`` to form the global array.
+    """
+    import grain
+
+    tokens, _ = read_token_file(path)
+    n_seq = (len(tokens) - 1) // seq_len
+    source = _GrainSeqSource(tokens, seq_len, n_seq)
+    sampler = grain.samplers.IndexSampler(
+        num_records=n_seq,
+        shard_options=grain.sharding.ShardByJaxProcess(drop_remainder=True),
+        shuffle=True,
+        seed=seed,
+    )
+    return grain.DataLoader(
+        data_source=source,
+        sampler=sampler,
+        operations=[grain.transforms.Batch(batch_size, drop_remainder=True)],
+        worker_count=num_workers,
+    )

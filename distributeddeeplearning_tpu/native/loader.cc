@@ -129,6 +129,12 @@ class Loader {
 
   void Start(int64_t start_index) {
     Stop();
+    // next_m_ serializes this reset against Next()'s claim, and the bumped
+    // generation invalidates any consumer still blocked from the previous
+    // stream (its wait predicate checks gen_), so a stale consumer can
+    // neither re-sleep past the restart nor steal the new stream's batches.
+    std::lock_guard<std::mutex> lk(next_m_);
+    gen_.fetch_add(1, std::memory_order_release);
     stop_.store(false, std::memory_order_relaxed);
     next_claim_.store(start_index, std::memory_order_relaxed);
     next_out_ = start_index;
@@ -139,22 +145,30 @@ class Loader {
   }
 
   // Copy the next batch (in index order) into caller buffers.
-  // Returns the batch index, or -1 if Stop() interrupted the wait (so a
-  // consumer blocked here cannot deadlock a concurrent Stop()/destructor).
+  // Returns the batch index, or -1 if Stop() or a superseding Start()
+  // interrupted the wait (so a consumer blocked here can neither deadlock
+  // a concurrent Stop()/destructor nor cross into a restarted stream).
   int64_t Next(float* data, int32_t* labels) {
-    int64_t want = next_out_++;
+    int64_t gen, want;
+    {
+      // Claim atomically with the generation snapshot: a Start() reset
+      // either happens entirely before (new-gen claim, valid) or entirely
+      // after (old-gen claim, predicate below bails with -1).
+      std::lock_guard<std::mutex> claim(next_m_);
+      gen = gen_.load(std::memory_order_acquire);
+      want = next_out_++;
+    }
     Slot& slot = *slots_[want % slots_.size()];
     {
       std::unique_lock<std::mutex> lk(slot.m);
       slot.cv.wait(lk, [&] {
         return stop_.load(std::memory_order_relaxed) ||
+               gen_.load(std::memory_order_acquire) != gen ||
                slot.index.load(std::memory_order_acquire) == want;
       });
-      if (slot.index.load(std::memory_order_acquire) != want) {
-        // Stopped: the stream is dead until the next Start() (which resets
-        // next_out_, so no rollback here — a rollback would race Start()'s
-        // reset from another thread).
-        return -1;
+      if (gen_.load(std::memory_order_acquire) != gen ||
+          slot.index.load(std::memory_order_acquire) != want) {
+        return -1;  // stream stopped or superseded; nothing consumed
       }
       std::memcpy(data, slot.data.data(), slot.data.size() * sizeof(float));
       std::memcpy(labels, slot.labels.data(),
@@ -281,7 +295,9 @@ class Loader {
   std::vector<std::unique_ptr<Slot>> slots_;
   std::vector<std::thread> workers_;
   std::atomic<bool> stop_{false};
+  std::atomic<int64_t> gen_{0};  // bumped by Start(); stale waiters bail
   std::atomic<int64_t> next_claim_{0};
+  std::mutex next_m_;  // serializes Next() claims against Start() resets
   int64_t next_out_ = 0;
   int64_t start_ = 0;
   std::mutex perm_m_;

@@ -1,0 +1,98 @@
+"""Tokenize raw text into the DDLTOK01 binary format consumed by the
+``token_file_*`` dataset kinds (``data_text.py``).
+
+The reference's LM workloads name Wikipedia / OpenWebText
+(``BASELINE.json:9-10``); this tool is the offline step that turns any such
+text dump into a training file:
+
+    python -m distributeddeeplearning_tpu.prepare_data \
+        --input corpus.txt --output corpus.tok --tokenizer byte
+
+Tokenizers:
+- ``byte`` (default) — UTF-8 bytes, vocab 256. No external assets, fully
+  deterministic; the right choice for tests and this zero-egress image.
+- ``hf:<name>`` — a HuggingFace tokenizer (e.g. ``hf:gpt2``) when its files
+  are available locally; fails with a clear message otherwise (no network
+  downloads are attempted).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from .data_text import write_token_file
+
+
+def tokenize_bytes(text: bytes) -> tuple[np.ndarray, int]:
+    return np.frombuffer(text, dtype=np.uint8).astype(np.uint16), 256
+
+
+# Text fed to the HF tokenizer per call. Bounds peak memory to a constant:
+# an OpenWebText-sized dump must never be resident as one Python string.
+_CHUNK_CHARS = 4 << 20
+
+
+def _chunks(path: str):
+    """Yield ~_CHUNK_CHARS text pieces, split on line boundaries so no word
+    is ever cut mid-chunk."""
+    buf: list[str] = []
+    size = 0
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            buf.append(line)
+            size += len(line)
+            if size >= _CHUNK_CHARS:
+                yield "".join(buf)
+                buf, size = [], 0
+    if buf:
+        yield "".join(buf)
+
+
+def tokenize_hf(path: str, name: str) -> tuple[np.ndarray, int]:
+    try:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(name, local_files_only=True)
+    except Exception as e:  # no local tokenizer assets / no transformers
+        raise SystemExit(
+            f"hf:{name} tokenizer unavailable locally ({e}); "
+            "use --tokenizer byte or provide the tokenizer files"
+        )
+    parts = [
+        np.asarray(tok(chunk)["input_ids"], dtype=np.int64)
+        for chunk in _chunks(path)
+    ]
+    ids = np.concatenate(parts) if parts else np.zeros(0, np.int64)
+    # len(tok), not tok.vocab_size: added/special tokens can carry ids past
+    # vocab_size, and the file header must bound every emitted id.
+    return ids, len(tok)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="prepare_data")
+    p.add_argument("--input", required=True, help="raw text file (UTF-8)")
+    p.add_argument("--output", required=True, help="DDLTOK01 output path")
+    p.add_argument(
+        "--tokenizer", default="byte", help="'byte' or 'hf:<model name>'"
+    )
+    args = p.parse_args(argv)
+
+    if args.tokenizer == "byte":
+        tokens, vocab = tokenize_bytes(open(args.input, "rb").read())
+    elif args.tokenizer.startswith("hf:"):
+        tokens, vocab = tokenize_hf(args.input, args.tokenizer[3:])
+    else:
+        raise SystemExit(f"unknown tokenizer {args.tokenizer!r}")
+    write_token_file(args.output, tokens, vocab)
+    print(
+        f"wrote {args.output}: {len(tokens):,} tokens, vocab {vocab}, "
+        f"{'uint16' if vocab <= 1 << 16 else 'uint32'}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

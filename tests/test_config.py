@@ -65,18 +65,25 @@ def test_dataset_kwargs_cover_every_kind():
 
     import numpy as np
 
-    with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+    from distributeddeeplearning_tpu.data_text import write_token_file
+
+    with tempfile.NamedTemporaryFile(suffix=".bin") as f, \
+            tempfile.NamedTemporaryFile(suffix=".tok") as tf:
         # record_file_image needs a real record file: 8 records of
         # 1 label byte + 32x32x3 uint8 payload (the DataConfig defaults).
         np.zeros((8, 1 + 32 * 32 * 3), np.uint8).tofile(f.name)
+        # token_file_* kinds need a DDLTOK01 file (vocab comes from the
+        # file header, not the config — so no vocab_size assert for them).
+        write_token_file(tf.name, np.zeros(4 * 128 + 1, np.int64), 256)
         for kind in data_lib.DATASET_KINDS:
+            token_kind = "token_file" in kind
             cfg = dataclasses.replace(
                 Config().data, kind=kind, vocab_size=512, batch_size=4,
-                path=f.name,
+                path=tf.name if token_kind else f.name,
             )
             ds = data_lib.make_dataset(kind, **cfg.dataset_kwargs())
             assert ds.batch_size == 4
-            if hasattr(ds, "vocab_size"):
+            if hasattr(ds, "vocab_size") and not token_kind:
                 assert ds.vocab_size == 512
             ds.batch(0)  # constructible and indexable
 

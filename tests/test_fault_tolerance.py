@@ -61,6 +61,73 @@ def test_crash_and_resume(tmp_path):
     assert '"step": 8' in resumed.stdout  # trained through to the end
 
 
+def _gpt2_file_cmd(tmp_path, token_path, extra):
+    return [
+        sys.executable, "-m", "distributeddeeplearning_tpu.cli", "train",
+        "--config", os.path.join(REPO, "configs", "gpt2_owt.py"),
+        "--override", 'model.kwargs={"size":"tiny","vocab_size":256,"max_len":64}',
+        "--override", "data.kind=token_file_lm",
+        "--override", f"data.path={token_path}",
+        "--override", "data.batch_size=8",
+        "--override", "data.seq_len=32",
+        "--override", "optim.warmup_steps=0",
+        "--override", "train.steps=8",
+        "--override", "train.log_every=1",
+        "--override", "train.save_every=2",
+        "--override", f"train.checkpoint_dir={tmp_path}/ckpt",
+        *extra,
+    ]
+
+
+def test_crash_and_resume_file_backed(tmp_path):
+    """Step-exact resume on the REAL-DATA path: train GPT-2 from an on-disk
+    token file, crash at step 5, relaunch — the resumed run's final losses
+    must match an uninterrupted run exactly (same data order, same state)."""
+    from distributeddeeplearning_tpu.data_text import write_token_file
+
+    token_path = str(tmp_path / "corpus.tok")
+    rng = np.random.default_rng(0)
+    write_token_file(token_path, rng.integers(0, 250, 16385, np.int64), 256)
+    env = dict(os.environ)
+
+    def losses_of(run):
+        import json
+
+        out = {}
+        for line in run.stdout.splitlines():
+            if line.startswith("{") and '"loss"' in line:
+                m = json.loads(line)
+                out[m["step"]] = m["loss"]
+        return out
+
+    uninterrupted = subprocess.run(
+        _gpt2_file_cmd(tmp_path / "a", token_path, []),
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=540,
+    )
+    assert uninterrupted.returncode == 0, uninterrupted.stderr[-2000:]
+
+    crashed = subprocess.run(
+        _gpt2_file_cmd(
+            tmp_path / "b", token_path,
+            ["--override", "train.fault_injection=step:5"],
+        ),
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=540,
+    )
+    assert crashed.returncode == 17, crashed.stderr[-2000:]
+    resumed = subprocess.run(
+        _gpt2_file_cmd(tmp_path / "b", token_path, []),
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=540,
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    assert "resumed from step 4" in resumed.stdout
+
+    want = losses_of(uninterrupted)
+    got = losses_of(resumed)
+    assert set(got) == {5, 6, 7, 8}  # resumed at step 4, trained 5..8
+    for step, loss in got.items():
+        np.testing.assert_allclose(loss, want[step], rtol=1e-5, err_msg=str(step))
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
