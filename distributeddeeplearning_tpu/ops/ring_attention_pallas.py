@@ -1,0 +1,263 @@
+"""Fused Pallas ring attention — the second of the two mandated ring
+implementations (SURVEY.md §5 long-context: "implemented twice: a
+pure-shard_map reference AND a Pallas v5e kernel").
+
+Division of labor, chosen for the TPU execution model:
+
+- The RING stays at the JAX level: ``shard_map`` + ``lax.ppermute`` rotate
+  the KV block one ICI neighbor per step, exactly as in the reference
+  implementation (``ring_attention.py``). Collectives emitted by XLA are
+  asynchronous; the latency-hiding scheduler overlaps the ppermute of step
+  t+1's block with the kernel of step t — in-kernel RDMA would buy nothing
+  on this axis and would forfeit XLA's scheduling.
+- The per-visit BLOCK ATTENTION is the fused Pallas kernel: a flash-style
+  blockwise pass over the visiting KV block that consumes and produces the
+  online-softmax carries (m, l, acc), so the [seq_local, seq_local] score
+  tile lives only in VMEM. This is the flash-attention forward kernel
+  (``flash_attention.py``) generalized to EXTERNAL carries: the softmax
+  state survives across ring steps instead of across one kernel's grid.
+
+Causality: device i's queries own global positions [i*Lq, (i+1)*Lq); at ring
+step t the visiting block is (i+t) mod cp. Fully-hidden blocks (src > i) are
+skipped at the JAX level with ``lax.cond`` (no kernel launch, no MXU work);
+the diagonal block applies the local causal mask inside the kernel (mode
+scalar in SMEM, since the visiting block id is a traced value).
+
+Backward: ``jax.custom_vjp`` whose bwd recomputes through the shard_map
+reference implementation — the designated correctness oracle — so training
+gradients are exactly the oracle's while the forward takes the fused path.
+A fused two-kernel ring backward (dq forward rotation, dk/dv reverse
+rotation) is the known next step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from ..mesh import BATCH_AXES
+from .flash_attention import _blk, _default_interpret
+from .ring_attention import _ring_attention_local
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _ring_step_kernel(
+    mode_ref,  # SMEM (1,1) int32: 1 = diagonal block (local causal mask)
+    q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+    m_out, l_out, acc_out,
+    m_scr, l_scr, acc_scr,
+    *, block_q, block_k, num_kv,
+):
+    """One visiting KV block folded into the online-softmax carries.
+
+    Grid: (batch*heads, q_blocks, kv_blocks); kv is the sequential innermost
+    dim, carries live in VMEM scratch across it, seeded from the inputs at
+    ki==0 and flushed to the outputs at ki==num_kv-1. q is pre-scaled.
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _load_carries():
+        m_scr[:] = jnp.broadcast_to(m_in[0], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_in[0], l_scr.shape)
+        acc_scr[:] = acc_in[0]
+
+    s = jax.lax.dot_general(
+        q_ref[0].astype(jnp.float32), k_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )  # (bq, bk)
+    row = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    col = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    # mode 0 (fully visible block): keep every score. mode 1 (diagonal):
+    # local causal mask. Hidden blocks never reach this kernel.
+    s = jnp.where((mode_ref[0, 0] == 0) | (row >= col), s, _NEG_INF)
+
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+        p, v_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_kv - 1)
+    def _flush_carries():
+        m_out[0] = m_scr[:, :1]
+        l_out[0] = l_scr[:, :1]
+        acc_out[0] = acc_scr[:]
+
+
+def _ring_step(qf, kt, vt, m, l, acc, mode, *, block_q, block_k, interpret):
+    """qf (pre-scaled fp32) [bh, lq, d]; kt/vt [bh, lk, d]; carries
+    m/l [bh, lq, 1], acc [bh, lq, d] -> updated carries."""
+    bh, lq, d = qf.shape
+    lk = kt.shape[1]
+    bq = _blk(lq, block_q, "ring q")
+    bk = _blk(lk, block_k, "ring k")
+    num_q, num_kv = lq // bq, lk // bk
+    kernel = functools.partial(
+        _ring_step_kernel, block_q=bq, block_k=bk, num_kv=num_kv,
+    )
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    c_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # mode scalar
+            q_spec, k_spec, k_spec, c_spec, c_spec, q_spec,
+        ],
+        out_specs=[c_spec, c_spec, q_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(mode, qf, kt, vt, m, l, acc)
+
+
+def _ring_local_pallas_fwd_impl(
+    q, k, v, *, axis_name, causal, block_q, block_k, interpret
+):
+    """Per-device forward (inside shard_map): scan ring steps, each step one
+    fused kernel launch + one KV rotation."""
+    cp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, lq, d)  # noqa: E731
+    qf = fold(q).astype(jnp.float32) * scale
+    kf, vf = fold(k), fold(v)
+
+    m0 = jnp.full((b * h, lq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b * h, lq, 1), jnp.float32)
+    acc0 = jnp.zeros((b * h, lq, d), jnp.float32)
+
+    def update(m, l, acc, kt, vt, t):
+        src = (idx + t) % cp
+        mode = jnp.where(src == idx, jnp.int32(1), jnp.int32(0)).reshape(1, 1)
+        step = functools.partial(
+            _ring_step, block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        if not causal:
+            return step(qf, kt, vt, m, l, acc, jnp.zeros((1, 1), jnp.int32))
+        # Hidden blocks (src > idx): no kernel launch at all.
+        return jax.lax.cond(
+            src <= idx,
+            lambda args: step(*args),
+            lambda args: (args[3], args[4], args[5]),
+            (qf, kt, vt, m, l, acc, mode),
+        )
+
+    def scan_step(carry, t):
+        m, l, acc, kt, vt = carry
+        m, l, acc = update(m, l, acc, kt, vt, t)
+        perm = [(i, (i - 1) % cp) for i in range(cp)]
+        kt = jax.lax.ppermute(kt, axis_name, perm)
+        vt = jax.lax.ppermute(vt, axis_name, perm)
+        return (m, l, acc, kt, vt), None
+
+    # Mirror the reference: scan cp-1 rotations, peel the final block so the
+    # last (unconsumed) ppermute is never emitted.
+    (m, l, acc, kt, vt), _ = jax.lax.scan(
+        scan_step, (m0, l0, acc0, kf, vf), jnp.arange(cp - 1)
+    )
+    m, l, acc = update(m, l, acc, kt, vt, cp - 1)
+
+    out = acc / jnp.maximum(l, 1e-30)  # [bh, lq, d]
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_local_pallas(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    return _ring_local_pallas_fwd_impl(
+        q, k, v, axis_name=axis_name, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _ring_local_pallas_fwd(
+    q, k, v, axis_name, causal, block_q, block_k, interpret
+):
+    out = _ring_local_pallas_fwd_impl(
+        q, k, v, axis_name=axis_name, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _ring_local_pallas_bwd(
+    axis_name, causal, block_q, block_k, interpret, res, g
+):
+    # Gradients via the shard_map reference implementation — the oracle —
+    # recomputed from the saved inputs (flash-style: activations are cheaper
+    # to recompute than to store).
+    q, k, v = res
+    _, vjp = jax.vjp(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_ring_local_pallas.defvjp(_ring_local_pallas_fwd, _ring_local_pallas_bwd)
+
+
+def ring_attention_pallas(
+    q, k, v, mesh: Mesh, *,
+    causal: bool = True,
+    axis_name: str = "cp",
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Fused-kernel ring attention over ``[batch, seq, heads, head_dim]``
+    global arrays — drop-in for :func:`ring_attention.ring_attention`
+    (same sharding contract: batch over BATCH_AXES, seq over ``axis_name``,
+    heads over 'tp')."""
+    from ..parallel.sp_ring import check_ring_shapes
+
+    check_ring_shapes(q.shape[1], mesh.shape[axis_name])
+    if q.shape[2] % mesh.shape["tp"]:
+        raise ValueError(
+            f"ring: heads={q.shape[2]} not divisible by tp={mesh.shape['tp']}"
+        )
+    if interpret is None:
+        interpret = _default_interpret()
+    spec = P(BATCH_AXES, axis_name, "tp", None)
+    fn = jax.shard_map(
+        lambda q, k, v: _ring_local_pallas(
+            q, k, v, axis_name, causal, block_q, block_k, interpret
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
