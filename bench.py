@@ -47,22 +47,38 @@ def _scrubbed_cpu_env() -> dict:
     return env
 
 
-def _probe_backend() -> str:
-    """Name of a *working* default backend, or 'cpu' if the accelerator is
-    unreachable/wedged. Runs in a subprocess so a hang cannot propagate."""
+def _probe_backend() -> tuple[str, dict]:
+    """(name of a *working* default backend or 'cpu', probe detail record).
+
+    Runs in a subprocess so a wedged-plugin hang cannot propagate. The detail
+    record lands in SMOKE_STATUS.json so every round's artifacts say
+    explicitly whether the chip was reachable (VERDICT r2 Next #4a)."""
+    detail = {"timeout_s": PROBE_TIMEOUT_S}
     try:
         out = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],
             capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
         )
     except subprocess.TimeoutExpired:
-        return "cpu"
+        detail["outcome"] = "hang"
+        detail["diagnosis"] = (
+            f"backend init did not return within {PROBE_TIMEOUT_S}s "
+            "(wedged accelerator plugin); benchmarking on CPU"
+        )
+        return "cpu", detail
     if out.returncode != 0:
-        return "cpu"
+        detail["outcome"] = "error"
+        detail["rc"] = out.returncode
+        detail["stderr_tail"] = out.stderr[-500:]
+        return "cpu", detail
     for line in out.stdout.splitlines():
         if line.startswith("BACKEND="):
-            return line.split("=", 1)[1].strip()
-    return "cpu"
+            backend = line.split("=", 1)[1].strip()
+            detail["outcome"] = "ok"
+            detail["backend"] = backend
+            return backend, detail
+    detail["outcome"] = "no-backend-line"
+    return "cpu", detail
 
 
 def _run_child(mode: str) -> dict | None:
@@ -146,8 +162,25 @@ def _child_main(mode: str) -> int:
     return 0
 
 
+def _write_smoke_status(status: dict) -> None:
+    """SMOKE_STATUS.json — the per-round chip-health artifact. Best-effort:
+    a read-only checkout must not break the benchmark contract."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SMOKE_STATUS.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(status, f, indent=2)
+            f.write("\n")
+    except OSError as e:
+        sys.stderr.write(f"bench.py: could not write SMOKE_STATUS.json: {e}\n")
+
+
 def main() -> int:
-    backend = _probe_backend()
+    import time
+
+    status = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    backend, probe_detail = _probe_backend()
+    status["probe"] = probe_detail
     record = None
     if backend != "cpu":
         record = _run_child("tpu")
@@ -155,17 +188,33 @@ def main() -> int:
             sys.stderr.write(
                 "bench.py: TPU child failed/timed out; falling back to CPU\n"
             )
+            status["tpu_child"] = "failed-or-timed-out"
+        else:
+            status["tpu_child"] = "ok"
     if record is None:
         record = _run_child("cpu")
+        status["cpu_child"] = "ok" if record is not None else "failed"
     if record is None:
         record = {
             "metric": "resnet50_imagenet_images_per_sec_per_chip",
             "value": 0.0,
             "unit": "images/sec/chip",
-            "vs_baseline": 0.0,
+            "vs_baseline": None,
             "platform": "none",
             "error": "both TPU and CPU benchmark children failed",
         }
+    # Chip health travels with the metric so a CPU fallback can never read
+    # as a TPU measurement (VERDICT r2 Weak #4): the chip counts as ok only
+    # if the probe saw it AND the TPU benchmark child completed on it.
+    record["chip_status"] = (
+        "ok"
+        if probe_detail.get("outcome") == "ok"
+        and probe_detail.get("backend") != "cpu"
+        and status.get("tpu_child") == "ok"
+        else "down"
+    )
+    status["record"] = record
+    _write_smoke_status(status)
     print(json.dumps(record))
     return 0
 

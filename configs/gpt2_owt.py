@@ -14,14 +14,23 @@ from distributeddeeplearning_tpu.mesh import MeshConfig
 def get_config() -> Config:
     return Config(
         model=ModelConfig(
-            name="gpt2", kwargs={"size": "124m", "max_len": 1024}
+            name="gpt2",
+            kwargs={
+                "size": "124m",
+                "max_len": 1024,
+                # Fused Pallas attention on the hot path; runs under
+                # shard_map over (dp,fsdp)×tp (ops/flash_attention.py).
+                "attn_impl": "flash",
+            },
         ),
         data=DataConfig(
             kind="synthetic_tokens", batch_size=32, seq_len=1024,
             vocab_size=50257,
         ),
         optim=OptimConfig(
-            name="adamw", lr=6e-4, b2=0.95, weight_decay=0.1,
+            # Fused Pallas optimizer update (ops/fused_adamw.py); grad_clip
+            # is applied inside the transformation (see make_optimizer).
+            name="adamw_fused", lr=6e-4, b2=0.95, weight_decay=0.1,
             schedule="cosine", warmup_steps=200, grad_clip=1.0,
         ),
         train=TrainConfig(steps=1000, log_every=20, task="lm", zero1=True),

@@ -24,14 +24,15 @@ from distributeddeeplearning_tpu.mesh import MeshConfig
 def get_config() -> Config:
     return Config(
         model=ModelConfig(
-            name="gpt2", kwargs={"size": "124m", "max_len": 1024}
+            name="gpt2",
+            kwargs={"size": "124m", "max_len": 1024, "attn_impl": "flash"},
         ),
         data=DataConfig(
             kind="token_file_lm", batch_size=32, seq_len=1024,
             path="",  # required: --override data.path=<corpus.tok>
         ),
         optim=OptimConfig(
-            name="adamw", lr=6e-4, b2=0.95, weight_decay=0.1,
+            name="adamw_fused", lr=6e-4, b2=0.95, weight_decay=0.1,
             schedule="cosine", warmup_steps=200, grad_clip=1.0,
         ),
         train=TrainConfig(

@@ -13,13 +13,17 @@ from distributeddeeplearning_tpu.mesh import MeshConfig
 
 def get_config() -> Config:
     return Config(
-        model=ModelConfig(name="vit", kwargs={"size": "l16"}),
+        model=ModelConfig(
+            # Fused Pallas attention: the 197-token sequence is padded to
+            # the kernel's block grid with masked padding columns.
+            name="vit", kwargs={"size": "l16", "attn_impl": "flash"}
+        ),
         data=DataConfig(
             kind="synthetic_image", batch_size=64, image_size=224,
             num_classes=21843,
         ),
         optim=OptimConfig(
-            name="adamw", lr=1e-3, weight_decay=0.05, schedule="cosine",
+            name="adamw_fused", lr=1e-3, weight_decay=0.05, schedule="cosine",
             warmup_steps=500, grad_clip=1.0,
         ),
         train=TrainConfig(

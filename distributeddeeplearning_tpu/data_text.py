@@ -44,7 +44,12 @@ def write_token_file(path: str, tokens, vocab_size: int) -> None:
     tokens = np.asarray(tokens)
     if tokens.ndim != 1:
         raise ValueError(f"tokens must be 1-D, got shape {tokens.shape}")
-    if vocab_size <= 0 or (len(tokens) and int(tokens.max()) >= vocab_size):
+    # Both bounds: negative int64 ids would otherwise wrap to large in-range
+    # garbage under the unsigned astype below (ADVICE r2 #3).
+    if vocab_size <= 0 or (
+        len(tokens)
+        and (int(tokens.max()) >= vocab_size or int(tokens.min()) < 0)
+    ):
         raise ValueError("tokens out of range for vocab_size")
     dtype = np.uint16 if vocab_size <= 1 << 16 else np.uint32
     with open(path, "wb") as f:
@@ -159,17 +164,29 @@ class TokenFileMLM(_TokenFileBase):
 
 
 class _GrainSeqSource:
-    """Grain RandomAccessDataSource view: sequence j of the token stream."""
+    """Grain RandomAccessDataSource view: sequence j of the token stream.
 
-    def __init__(self, tokens: np.memmap, seq_len: int, n_seq: int):
-        self._tokens = tokens
+    Holds the file PATH, not the memmap: Grain pickles the source into each
+    worker process, and a pickled ``np.memmap`` round-trips as a plain
+    ndarray — every worker would materialize the whole corpus in RAM
+    (ADVICE r2 #4). Each process re-opens its own memmap lazily instead.
+    """
+
+    def __init__(self, path: str, seq_len: int, n_seq: int):
+        self._path = path
         self._seq_len = seq_len
         self._n_seq = n_seq
+        self._tokens = None  # per-process memmap, opened on first access
 
     def __len__(self) -> int:
         return self._n_seq
 
+    def __getstate__(self):
+        return {**self.__dict__, "_tokens": None}
+
     def __getitem__(self, j: int) -> np.ndarray:
+        if self._tokens is None:
+            self._tokens, _ = read_token_file(self._path)
         start = j * self._seq_len
         return np.asarray(
             self._tokens[start : start + self._seq_len + 1], np.int32
@@ -201,7 +218,7 @@ class GrainTokenFileLM(IndexedDataset):
             raise ValueError(
                 f"{self.path}: only {n_seq} sequences; need >= batch_size"
             )
-        source = _GrainSeqSource(tokens, self.seq_len, n_seq)
+        source = _GrainSeqSource(self.path, self.seq_len, n_seq)
         self._ds = (
             grain.MapDataset.source(source)
             .seed(self.seed)
@@ -235,7 +252,7 @@ def grain_per_host_loader(
 
     tokens, _ = read_token_file(path)
     n_seq = (len(tokens) - 1) // seq_len
-    source = _GrainSeqSource(tokens, seq_len, n_seq)
+    source = _GrainSeqSource(path, seq_len, n_seq)
     sampler = grain.samplers.IndexSampler(
         num_records=n_seq,
         shard_options=grain.sharding.ShardByJaxProcess(drop_remainder=True),

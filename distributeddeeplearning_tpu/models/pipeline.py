@@ -19,9 +19,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.sharding import PartitionSpec
 
 from . import register
-from ..parallel.pp import check_pipeline_shapes, gpipe, sequential, stack_stage_axis
+from ..parallel.pp import (
+    check_pipeline_shapes,
+    gpipe,
+    one_f_one_b,
+    sequential,
+    stack_stage_axis,
+)
 from ..sharding import constrain
 from .transformer import TransformerBlock, layer_norm
 
@@ -29,7 +36,9 @@ from .transformer import TransformerBlock, layer_norm
 class PipelineStage(nn.Module):
     """``layers_per_stage`` transformer blocks, constraint-free (the stage
     body runs inside shard_map where global sharding constraints don't
-    apply)."""
+    apply). ``psum_axis`` enables manual TP inside the stage (PP×TP): the
+    module is then constructed with tp-LOCAL head/mlp counts and the blocks
+    psum their row-parallel outputs over that axis."""
 
     num_layers: int
     num_heads: int
@@ -41,6 +50,7 @@ class PipelineStage(nn.Module):
     ln_eps: float = 1e-5
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    psum_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -56,6 +66,7 @@ class PipelineStage(nn.Module):
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
                 constrain_out=False,
+                psum_axis=self.psum_axis,
                 name=f"block_{i}",
             )(x, None, deterministic)
         return x
@@ -82,12 +93,18 @@ class PipelinedTransformerStack(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     pipeline: bool = True
+    schedule: str = "gpipe"  # gpipe | 1f1b (see parallel/pp.py)
     mesh: object = None  # jax.sharding.Mesh, required when pipelining
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
         if mask is not None:
             raise NotImplementedError("pipelined stack supports mask=None only")
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline schedule {self.schedule!r}; "
+                "expected 'gpipe' or '1f1b'"
+            )
         if self.dropout_rate and not deterministic:
             raise NotImplementedError(
                 "dropout inside pipeline stages is not supported (set "
@@ -104,17 +121,45 @@ class PipelinedTransformerStack(nn.Module):
         check_pipeline_shapes(
             local_batch, self.num_microbatches, self.num_layers, self.num_stages
         )
-        stage_mod = PipelineStage(
-            self.num_layers // self.num_stages,
-            self.num_heads,
-            self.head_dim,
-            self.mlp_dim,
+        # PP×TP: tensor parallelism runs INSIDE each stage — stage params are
+        # additionally sharded over tp (heads/mlp dims), the body sees
+        # tp-local sizes, and the blocks psum their row-parallel outputs.
+        tp = self.mesh.shape["tp"] if use_pipeline else 1
+        if tp > 1:
+            if self.num_heads % tp or self.mlp_dim % tp:
+                raise ValueError(
+                    f"pp×tp: num_heads={self.num_heads} and "
+                    f"mlp_dim={self.mlp_dim} must be divisible by tp={tp}"
+                )
+        stage_kw = dict(
             pre_ln=self.pre_ln,
             causal=self.causal,
             activation=self.activation,
             ln_eps=self.ln_eps,
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
+        )
+        # Init always uses the GLOBAL module (full head/mlp counts): stored
+        # parameters are the full weights; the tp slicing happens at the
+        # shard_map boundary via param_specs.
+        stage_mod = PipelineStage(
+            self.num_layers // self.num_stages,
+            self.num_heads,
+            self.head_dim,
+            self.mlp_dim,
+            **stage_kw,
+        )
+        stage_mod_body = (
+            PipelineStage(
+                self.num_layers // self.num_stages,
+                self.num_heads // tp,
+                self.head_dim,
+                self.mlp_dim // tp,
+                psum_axis="tp",
+                **stage_kw,
+            )
+            if tp > 1
+            else stage_mod
         )
         dummy = jnp.zeros((1,) + x.shape[1:], x.dtype)
 
@@ -125,12 +170,29 @@ class PipelinedTransformerStack(nn.Module):
 
         stacked = self.param("stages", init_stacked)
 
+        def scale_row_parallel_biases(tree):
+            """Pre-scale the row-parallel biases (attn out / mlp fc_out) by
+            1/tp: each tp rank adds the bias to its partial sum, the psum
+            then restores exactly one bias."""
+
+            def fix(path, leaf):
+                keys = [getattr(p, "key", None) for p in path]
+                if keys[-1] == "bias" and keys[-2] in ("out", "fc_out"):
+                    return leaf / tp
+                return leaf
+
+            return jax.tree_util.tree_map_with_path(fix, tree)
+
         def stage_fn(stage_params, y):
             # Clear the ambient logical-axis rules: inside shard_map arrays
             # are per-device (manual) and flax's param-unbox constraint (which
             # resolves against the rules) must become a no-op.
+            if tp > 1:
+                stage_params = scale_row_parallel_biases(stage_params)
             with nn.logical_axis_rules(()):
-                return stage_mod.apply({"params": stage_params}, y, deterministic)
+                return stage_mod_body.apply(
+                    {"params": stage_params}, y, deterministic
+                )
 
         if use_pipeline:
             if self.mesh.shape["pp"] != self.num_stages:
@@ -138,12 +200,29 @@ class PipelinedTransformerStack(nn.Module):
                     f"mesh pp={self.mesh.shape['pp']} != "
                     f"num_stages={self.num_stages}"
                 )
-            return gpipe(
+            param_specs = None
+            if tp > 1:
+                # Per-leaf specs from the stacked Partitioned names:
+                # stage -> pp, heads/mlp -> tp, everything else replicated.
+                table = {"stage": "pp", "heads": "tp", "mlp": "tp"}
+                abs_stacked = jax.eval_shape(
+                    init_stacked, jax.random.PRNGKey(0)
+                )
+                param_specs = jax.tree.map(
+                    lambda b: PartitionSpec(
+                        *[table.get(n) for n in b.names]
+                    ),
+                    abs_stacked,
+                    is_leaf=lambda l: isinstance(l, nn.Partitioned),
+                )
+            engine = {"gpipe": gpipe, "1f1b": one_f_one_b}[self.schedule]
+            return engine(
                 stage_fn,
                 stacked,
                 x,
                 mesh=self.mesh,
                 num_microbatches=self.num_microbatches,
+                param_specs=param_specs,
             )
         return sequential(stage_fn, stacked, x)
 
@@ -160,6 +239,7 @@ class PipelinedGPT2(nn.Module):
     num_stages: int = 2
     num_microbatches: int = 2
     pipeline: bool = True
+    schedule: str = "gpipe"  # gpipe | 1f1b
     dtype: jnp.dtype = jnp.float32
     mesh: object = None
 
@@ -172,8 +252,11 @@ class PipelinedGPT2(nn.Module):
             self.vocab_size,
             self.embed_dim,
             dtype=self.dtype,
+            # 'vocab_pp': vocab sharded over (tp, pp) — the embedding/tied
+            # head is stored split across pipeline stages instead of
+            # replicated per pp rank (the GPipe-v1 replication tax).
             embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("vocab", "embed")
+                nn.initializers.normal(0.02), ("vocab_pp", "embed")
             ),
             name="wte",
         )
@@ -201,6 +284,7 @@ class PipelinedGPT2(nn.Module):
             ln_eps=1e-5,
             dtype=self.dtype,
             pipeline=self.pipeline,
+            schedule=self.schedule,
             mesh=self.mesh,
             name="h",
         )(x, None, not train)

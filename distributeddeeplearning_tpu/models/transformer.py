@@ -51,6 +51,9 @@ class SelfAttention(nn.Module):
     - ``ring``:    explicit shard_map ring attention over ``cp`` with
                    ppermute KV rotation (``ops/ring_attention.py``); needs
                    ``mesh`` and supports mask=None, dropout=0 only;
+    - ``ring_pallas``: same ring, per-visit block attention fused into a
+                   Pallas kernel (``ops/ring_attention_pallas.py``); same
+                   constraints as ``ring``;
     - ``flash``:   fused Pallas flash-attention kernel
                    (``ops/flash_attention.py``); mask=None, dropout=0 only.
     """
@@ -61,8 +64,13 @@ class SelfAttention(nn.Module):
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.float32
     init_scale: float = 0.02
-    attn_impl: str = "xla"  # xla | ulysses | ring | flash
-    mesh: object = None  # jax.sharding.Mesh, required for attn_impl='ring'
+    attn_impl: str = "xla"  # xla | ulysses | ring | ring_pallas | flash
+    mesh: object = None  # jax.sharding.Mesh, required for ring variants
+    # Manual tensor parallelism (inside an explicit shard_map, e.g. PP×TP):
+    # this module then sees tp-LOCAL head counts and psums the row-parallel
+    # out-projection over this axis. The out bias must be pre-scaled 1/tp by
+    # the caller (it is added per-rank before the psum).
+    psum_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -91,17 +99,21 @@ class SelfAttention(nn.Module):
             from ..ops import flash_attention
 
             out = flash_attention(q, k, v, causal=self.causal)
-        elif self.attn_impl == "ring":
+        elif self.attn_impl in ("ring", "ring_pallas"):
             if mask is not None or (self.dropout_rate and not deterministic):
                 raise NotImplementedError(
                     "ring attention supports mask=None and no active "
                     "attention-dropout"
                 )
             if self.mesh is None:
-                raise ValueError("attn_impl='ring' requires mesh")
-            from ..ops import ring_attention
+                raise ValueError(
+                    f"attn_impl={self.attn_impl!r} requires mesh"
+                )
+            from ..parallel.sp_ring import ring_attention_fn
 
-            out = ring_attention(q, k, v, self.mesh, causal=self.causal)
+            out = ring_attention_fn(self.attn_impl)(
+                q, k, v, self.mesh, causal=self.causal
+            )
         else:
             if self.attn_impl == "ulysses":
                 if self.mesh is not None:
@@ -115,10 +127,9 @@ class SelfAttention(nn.Module):
                     )
                 # Reshard seq->heads for the attention core; the inverse
                 # constraint below restores the seq-sharded layout.
-                reshard = lambda t: constrain(  # noqa: E731
-                    t, "batch", "seq_attn", "heads_attn", "kv"
-                )
-                q, k, v = reshard(q), reshard(k), reshard(v)
+                from ..parallel.sp_ulysses import ulysses_reshard
+
+                q, k, v = ulysses_reshard(q, k, v)
             elif self.attn_impl != "xla":
                 raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
@@ -138,7 +149,9 @@ class SelfAttention(nn.Module):
             )
             out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
             if self.attn_impl == "ulysses":
-                out = constrain(out, "batch", "seq", "heads", "kv")
+                from ..parallel.sp_ulysses import ulysses_restore
+
+                out = ulysses_restore(out)
         out = nn.DenseGeneral(
             features=features,
             axis=(-2, -1),
@@ -151,6 +164,8 @@ class SelfAttention(nn.Module):
             ),
             name="out",
         )(out)
+        if self.psum_axis is not None:
+            out = jax.lax.psum(out, self.psum_axis)
         return out
 
 
@@ -160,6 +175,10 @@ class Mlp(nn.Module):
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.float32
     init_scale: float = 0.02
+    # Manual TP (see SelfAttention.psum_axis): hidden_dim is tp-local and
+    # fc_out is the row-parallel matmul reduced here; fc_out bias must be
+    # pre-scaled 1/tp by the caller.
+    psum_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -186,6 +205,8 @@ class Mlp(nn.Module):
             ),
             name="fc_out",
         )(h)
+        if self.psum_axis is not None:
+            h = jax.lax.psum(h, self.psum_axis)
         return nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
 
 
@@ -215,6 +236,8 @@ class TransformerBlock(nn.Module):
     # Pipeline stages run inside an explicit shard_map where global sharding
     # constraints are meaningless — they disable the block-boundary constraint.
     constrain_out: bool = True
+    # Manual TP inside shard_map (PP×TP): forwarded to the attn/mlp modules.
+    psum_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -227,6 +250,7 @@ class TransformerBlock(nn.Module):
             init_scale=self.init_scale,
             attn_impl=self.attn_impl,
             mesh=self.mesh,
+            psum_axis=self.psum_axis,
             name="attn",
         )
         mlp = Mlp(
@@ -235,6 +259,7 @@ class TransformerBlock(nn.Module):
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
             init_scale=self.init_scale,
+            psum_axis=self.psum_axis,
             name="mlp",
         )
         ln1 = layer_norm(self.ln_eps, self.dtype, "ln1")

@@ -28,6 +28,7 @@ class ViT(nn.Module):
     dropout_rate: float = 0.0
     remat: str = "none"
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"  # 197 tokens: flash pads to the block grid
 
     @nn.compact
     def __call__(self, images, train: bool = False):
@@ -76,6 +77,7 @@ class ViT(nn.Module):
             dropout_rate=self.dropout_rate,
             remat=self.remat,
             dtype=self.dtype,
+            attn_impl=self.attn_impl,
             name="encoder",
         )(x, None, not train)
         x = layer_norm(1e-12, self.dtype, "ln_f")(x)

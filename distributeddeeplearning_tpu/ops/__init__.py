@@ -9,3 +9,4 @@ kernels provide fused alternatives for the hot ops on real TPU.
 from .flash_attention import attention_reference, flash_attention  # noqa: F401
 from .fused_adamw import fused_adamw  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .ring_attention_pallas import ring_attention_pallas  # noqa: F401

@@ -26,12 +26,16 @@ Layout notes (see pallas_guide.md):
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from ..mesh import BATCH_AXES
 
 _NEG_INF = -1e30  # finite: exp(_NEG_INF - m) == 0 exactly, no inf-inf NaNs
 _LANES = 128
@@ -55,7 +59,7 @@ def _blk(seq: int, requested: int, name: str) -> int:
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, block_q, block_k, num_kv,
+    *, sm_scale, causal, block_q, block_k, num_kv, valid_len=None,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -87,6 +91,16 @@ def _fwd_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(row >= col, s, _NEG_INF)
+        elif valid_len is not None:
+            # Sequence was right-padded to a block multiple (valid_len is the
+            # true length, a compile-time constant): padded kv columns must
+            # not contribute. Padded q rows produce garbage rows the wrapper
+            # slices away. Under causal the diagonal mask already excludes
+            # every padded column for valid rows.
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col < valid_len, s, _NEG_INF)
         m_prev = m_scr[:, :1]  # (bq, 1)
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -107,7 +121,8 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+         valid_len=None):
     """q/k/v: [bh, seq, d] -> (o [bh, seq, d], lse [bh, seq] fp32)."""
     bh, seq, d = q.shape
     block_q = _blk(seq, block_q, "flash fwd q")
@@ -118,6 +133,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv=num_kv,
+        valid_len=valid_len,
     )
     return pl.pallas_call(
         kernel,
@@ -152,7 +168,9 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki, bq, bk):
+def _recompute_p(
+    q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki, bq, bk, valid_len=None
+):
     """exp(scale*QK^T - lse) for one (q-block, kv-block) tile, fp32."""
     q = q_ref[0].astype(jnp.float32) * sm_scale
     k = k_ref[0].astype(jnp.float32)
@@ -163,6 +181,9 @@ def _recompute_p(q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki, bq, bk):
         row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         col = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(row >= col, s, _NEG_INF)
+    elif valid_len is not None:
+        col = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(col < valid_len, s, _NEG_INF)
     return jnp.exp(s - lse_ref[0])  # lse block is (bq, 1); masked -> 0
 
 
@@ -176,7 +197,7 @@ def _delta(o_ref, do_ref):
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr, delta_scr,
-    *, sm_scale, causal, block_q, block_k, num_kv,
+    *, sm_scale, causal, block_q, block_k, num_kv, valid_len=None,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -195,7 +216,7 @@ def _dq_kernel(
     def _block():
         p = _recompute_p(
             q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki,
-            block_q, block_k,
+            block_q, block_k, valid_len,
         )
         do = do_ref[0].astype(jnp.float32)  # (bq, d)
         dp = jax.lax.dot_general(
@@ -216,7 +237,7 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, sm_scale, causal, block_q, block_k, num_q,
+    *, sm_scale, causal, block_q, block_k, num_q, valid_len=None,
 ):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -234,7 +255,7 @@ def _dkv_kernel(
     def _block():
         p = _recompute_p(
             q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki,
-            block_q, block_k,
+            block_q, block_k, valid_len,
         )  # (bq, bk)
         do = do_ref[0].astype(jnp.float32)  # (bq, d)
         dv_scr[:] += jax.lax.dot_general(
@@ -257,7 +278,7 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+def _bwd(causal, sm_scale, block_q, block_k, interpret, valid_len, res, do):
     q, k, v, o, lse = res
     bh, seq, d = q.shape
     block_q = _blk(seq, block_q, "flash bwd q")
@@ -271,6 +292,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kv=num_kv,
+            valid_len=valid_len,
         ),
         grid=(bh, num_q, num_kv),
         in_specs=[q_spec_q, k_spec_q, k_spec_q, q_spec_q, q_spec_q,
@@ -292,6 +314,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q=num_q,
+            valid_len=valid_len,
         ),
         grid=(bh, num_kv, num_q),
         in_specs=[q_spec_k, k_spec_k, k_spec_k, q_spec_k, q_spec_k,
@@ -310,14 +333,18 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+           valid_len=None):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                valid_len)
     return o
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+               valid_len):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                  valid_len)
     return o, (q, k, v, o, lse)
 
 
@@ -336,12 +363,21 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    mesh=None,
 ):
     """Fused attention over ``[batch, seq, heads, head_dim]`` inputs.
 
     Matches ``softmax(scale * Q K^T [+ causal mask]) V`` with fp32 softmax,
     differentiable via the flash backward kernels. ``interpret=None`` auto-
     selects interpret mode off-TPU (CPU test harness).
+
+    Sharding: a ``pallas_call`` is an opaque custom call the SPMD partitioner
+    would replicate around, so under a mesh (passed explicitly or ambient via
+    ``sharding.activation_mesh`` — the Trainer's steps install one) the kernel
+    runs inside ``shard_map`` over batch ('dp','fsdp') and heads ('tp') —
+    attention is independent per (batch, head), so each shard's kernel is the
+    whole computation for its slice. Sequence stays unsharded (ring attention
+    covers cp>1).
     """
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
@@ -350,12 +386,58 @@ def flash_attention(
         sm_scale = float(1.0 / np.sqrt(d))
     if interpret is None:
         interpret = _default_interpret()
-    to_bhsd = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
-    o = _flash(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v),
-        causal, sm_scale, block_q, block_k, interpret,
-    )
-    return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    def local(q, k, v):
+        lb, ls, lh, ld = q.shape
+        # Non-block-multiple sequences (ViT's 197 tokens, BERT's 509, ...)
+        # are right-padded to the block grid; padded kv columns are masked
+        # inside the kernels via the static valid_len, padded q rows sliced
+        # off here. No dynamic shapes reach Mosaic. The effective block
+        # sizes chosen here are passed INTO the kernels (recomputing them
+        # from the padded length would disagree with the pad).
+        bq, bk = min(block_q, ls), min(block_k, ls)
+        if ls % bq == 0 and ls % bk == 0:
+            ls_p, valid = ls, None
+        else:
+            # One common block keeps the pad bounded at < block (the lcm of
+            # unequal blocks can blow the pad up to bq*bk).
+            bq = bk = min(bq, bk)
+            ls_p = ((ls + bq - 1) // bq) * bq
+            valid = ls
+            pad = lambda t: jnp.pad(t, ((0, 0), (0, ls_p - ls), (0, 0), (0, 0)))  # noqa: E731
+            q, k, v = pad(q), pad(k), pad(v)
+        to_bhsd = lambda t: t.transpose(0, 2, 1, 3).reshape(lb * lh, ls_p, ld)  # noqa: E731
+        o = _flash(
+            to_bhsd(q), to_bhsd(k), to_bhsd(v),
+            causal, sm_scale, bq, bk, interpret, valid,
+        )
+        o = o.reshape(lb, lh, ls_p, ld).transpose(0, 2, 1, 3)
+        return o[:, :ls] if valid is not None else o
+
+    if mesh is None:
+        from ..sharding import _MESH_CTX
+
+        mesh = _MESH_CTX.get()
+    if mesh is not None:
+        batch_ways = math.prod(mesh.shape[a] for a in BATCH_AXES)
+        tp = mesh.shape["tp"]
+        if batch_ways * tp > 1:
+            if b % batch_ways:
+                raise ValueError(
+                    f"flash: batch={b} not divisible by dp*fsdp={batch_ways}"
+                )
+            if h % tp:
+                raise ValueError(f"flash: heads={h} not divisible by tp={tp}")
+            spec = P(BATCH_AXES, None, "tp", None)
+            # check_vma=False: same jax-0.9.0 pallas-in-shard_map typing
+            # limitation as ring_attention_pallas.py — no collectives exist
+            # in the body, each shard is independent.
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+    return local(q, k, v)
 
 
 def attention_reference(q, k, v, *, causal: bool = False, sm_scale=None):

@@ -112,6 +112,26 @@ class FusedAdamWState(NamedTuple):
     nu: optax.Updates  # fp32 second moments, params-shaped
 
 
+class FusedAdamWTransformation(NamedTuple):
+    """Duck-types ``optax.GradientTransformation`` (init/update) while also
+    carrying the clip threshold, so the Trainer can apply the global-norm
+    clip in the auto-sharded region *before* entering the shard_map around
+    the kernel (a per-shard norm would be wrong there). Global-norm clipping
+    is idempotent, so the in-update clip below is then a guaranteed no-op —
+    direct users of this transformation still get clipping without a chain.
+    """
+
+    init: object
+    update: object
+    grad_clip: float = 0.0
+
+
+def _clip_by_global_norm(grads, clip: float):
+    norm = optax.global_norm(grads)
+    scale = clip / jnp.maximum(norm, clip)
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+
+
 def fused_adamw(
     learning_rate,
     b1: float = 0.9,
@@ -119,13 +139,17 @@ def fused_adamw(
     eps: float = 1e-8,
     weight_decay: float = 0.0,
     *,
+    grad_clip: float = 0.0,
     interpret: bool | None = None,
 ) -> optax.GradientTransformation:
     """optax-compatible AdamW whose update rule is the Pallas kernel.
 
     ``learning_rate`` may be a float or an optax schedule. Returned updates
     are deltas (feed ``optax.apply_updates``), so it chains with clipping
-    exactly like ``optax.adamw``.
+    exactly like ``optax.adamw``. Prefer ``grad_clip`` here over an outer
+    ``optax.chain(clip, ...)`` — a chain's tuple state hides the
+    ``FusedAdamWState`` from the Trainer's shard_map dispatch and the kernel
+    would fall back to the gather-everything path.
     """
 
     def init_fn(params):
@@ -140,6 +164,8 @@ def fused_adamw(
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("fused_adamw requires params")
+        if grad_clip:
+            grads = _clip_by_global_norm(grads, grad_clip)
         ip = _default_interpret() if interpret is None else interpret
         # optax convention: the schedule sees the pre-increment count, the
         # bias correction the post-increment one.
@@ -169,4 +195,4 @@ def fused_adamw(
             count=count, mu=unzip(1), nu=unzip(2)
         )
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    return FusedAdamWTransformation(init_fn, update_fn, grad_clip)

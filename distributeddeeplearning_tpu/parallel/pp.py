@@ -22,10 +22,26 @@ Autodiff: ``scan`` + ``ppermute`` are differentiable; the backward pass is
 automatically the reverse pipeline (cotangents ppermute stage ``s+1 -> s``),
 i.e. GPipe's synchronous backward schedule falls out of ``jax.grad``.
 
+Schedules:
+
+- ``gpipe`` — forward tick loop differentiated by ``jax.grad``: the scan's
+  autodiff stores every per-tick intermediate of every stage body (attention
+  scores, MLP hiddens, ...) for the whole M+S-1 ticks. Simple, memory-heavy.
+- ``1f1b`` (:func:`one_f_one_b`) — same forward schedule, but a
+  ``jax.custom_vjp`` whose residuals are ONLY each stage's per-microbatch
+  *inputs*; the backward runs the 1F1B reverse pipeline (stage ``s`` does
+  the backward of microbatch ``m`` as soon as stage ``s+1`` hands it the
+  cotangent, recomputing the stage forward from the stashed input). This is
+  1F1B-with-remat's backward ordering and memory profile; the
+  loss-inside-the-schedule variant (true interleaved fwd/bwd, which would
+  need the Trainer to delegate grad computation to the pipeline) is the
+  known next step. Peak-memory win vs gpipe is asserted by
+  ``tests/test_pipeline.py`` via compiled memory analysis.
+
 Composability: batch axes (``dp``/``fsdp``) pass straight through the
-``shard_map`` specs, so PP x DP works out of the box. Stage-internal tensor
-parallelism (PP x TP) would need manual collectives inside the stage body and
-is deliberately out of scope for the GPipe v1 (use TP or PP, or PP x DP).
+``shard_map`` specs, so PP x DP works out of the box. PP x TP runs tensor
+parallelism *inside* each stage (tp-sliced stage params + boundary psums);
+see ``models/pipeline.py``.
 """
 
 from __future__ import annotations
@@ -98,6 +114,175 @@ def _gpipe_local(stage_fn, params, x, *, axis_name: str, num_microbatches: int):
     return outputs.reshape(x.shape)
 
 
+def gpipe_bubble_fraction(num_microbatches: int, num_stages: int) -> float:
+    """Fraction of schedule ticks a stage spends idle: (S-1)/(M+S-1)."""
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)
+
+
+def _pp_local_fwd(stage_fn, params, x, *, axis_name, num_microbatches):
+    """GPipe forward tick loop that ALSO stashes each stage's per-microbatch
+    input (the 1F1B backward residuals). Returns (outputs, stash)."""
+    S = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    M = num_microbatches
+    params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+    mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+    buf0 = jax.lax.pcast(jnp.zeros_like(mb[0]), (axis_name,), to="varying")
+    out0 = jax.lax.pcast(jnp.zeros_like(mb), (axis_name,), to="varying")
+    stash0 = jax.lax.pcast(jnp.zeros_like(mb), (axis_name,), to="varying")
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        state_in, outputs, stash = carry
+        m = t - stage  # microbatch this stage processes at tick t
+        valid = (m >= 0) & (m < M)
+        m_idx = jnp.clip(m, 0, M - 1)
+        x_in = jnp.where(stage == 0, mb[jnp.minimum(t, M - 1)], state_in)
+        stash = jnp.where(valid, stash.at[m_idx].set(x_in), stash)
+        y = stage_fn(params, x_in)
+        out_t = t - (S - 1)
+        outputs = jnp.where(
+            (stage == S - 1) & (out_t >= 0),
+            outputs.at[jnp.clip(out_t, 0, M - 1)].set(y),
+            outputs,
+        )
+        state_next = jax.lax.ppermute(y, axis_name, perm)
+        return (state_next, outputs, stash), None
+
+    (_, outputs, stash), _ = jax.lax.scan(
+        tick, (buf0, out0, stash0), jnp.arange(M + S - 1)
+    )
+    # NOTE: outputs are returned pp-varying (real data only on the last
+    # stage, zeros elsewhere); the caller psums OUTSIDE the custom_vjp so
+    # the vma checker types the broadcast and its transpose delivers the
+    # full output cotangent to every device.
+    return outputs.reshape(x.shape), stash
+
+
+def _pp_local_bwd(stage_fn, params, stash, g, *, axis_name, num_microbatches):
+    """Reverse (1F1B-ordered) pipeline: stage ``s`` runs the backward of
+    microbatch ``m`` at tick ``(S-1-s) + (M-1-m)``, recomputing the stage
+    forward from the stashed input and handing the input-cotangent one hop
+    backwards (``s+1 -> s``). Param grads accumulate locally per stage.
+    Returns (dparams [1, ...] leaves, dx)."""
+    S = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    M = num_microbatches
+    params_sq = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+    gmb = g.reshape((M, g.shape[0] // M) + g.shape[1:])
+
+    # params/stash/g are all already pp-varying here (params via in_specs,
+    # stash as a fwd residual, g via the psum transpose), so plain zeros_like
+    # carries the right vma typing.
+    dparams0 = jax.tree.map(lambda a: jnp.zeros_like(a), params_sq)
+    dx0 = jnp.zeros_like(gmb)
+    recv0 = jnp.zeros_like(gmb[0])
+    perm_back = [(i + 1, i) for i in range(S - 1)]
+
+    def tick(carry, u):
+        dparams, dx_out, recv = carry
+        k = u - (S - 1 - stage)  # position in this stage's backward sequence
+        m = (M - 1) - k  # microbatch whose cotangent is handled now
+        valid = (k >= 0) & (k < M)
+        m_idx = jnp.clip(m, 0, M - 1)
+        g_in = jnp.where(stage == S - 1, gmb[m_idx], recv)
+        x_in = stash[m_idx]
+        # Recompute the stage forward (1F1B-with-remat): the vjp sees only
+        # one microbatch's activations at a time.
+        _, vjp_fn = jax.vjp(stage_fn, params_sq, x_in)
+        dp, dxi = vjp_fn(g_in)
+        dparams = jax.tree.map(
+            lambda a, b: a + jnp.where(valid, b, jnp.zeros_like(b)),
+            dparams, dp,
+        )
+        dx_out = jnp.where(
+            (stage == 0) & valid, dx_out.at[m_idx].set(dxi), dx_out
+        )
+        send = jnp.where(valid, dxi, jnp.zeros_like(dxi))
+        recv = jax.lax.ppermute(send, axis_name, perm_back)
+        return (dparams, dx_out, recv), None
+
+    (dparams, dx_out, _), _ = jax.lax.scan(
+        tick, (dparams0, dx0, recv0), jnp.arange(M + S - 1)
+    )
+    dparams = jax.tree.map(lambda a: jnp.expand_dims(a, 0), dparams)
+    # x entered replicated over pp, so its cotangent must leave the body
+    # pp-invariant: only stage 0 holds real input-cotangents, the psum is
+    # the broadcast (and satisfies the vma transpose typing).
+    dx_out = jax.lax.psum(dx_out, axis_name)
+    return dparams, dx_out.reshape(g.shape)
+
+
+def one_f_one_b(
+    stage_fn,
+    stacked_params,
+    x,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    param_specs=None,
+):
+    """Drop-in for :func:`gpipe` with the 1F1B backward schedule.
+
+    Same stacked-params interface and forward semantics; the difference is
+    entirely in ``jax.grad``: residuals are each stage's per-microbatch
+    inputs only (one activation tensor per microbatch instead of every
+    intermediate of every tick), and the backward runs the reverse pipeline
+    with per-microbatch recompute.
+
+    ``param_specs``: optional per-leaf PartitionSpecs for the stacked params
+    (default ``P('pp')`` on the leading stage dim). PP×TP passes specs that
+    additionally shard heads/mlp dims over ``tp``; the stage_fn is then
+    responsible for the tp boundary psums (see ``models/pipeline.py``).
+    """
+    S = mesh.shape[axis_name]
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    x_spec = P(BATCH_AXES)
+    if S == 1:
+        return sequential(stage_fn, stacked_params, x)
+
+    @jax.custom_vjp
+    def core(params, x):
+        out, _ = _pp_local_fwd(
+            stage_fn, params, x,
+            axis_name=axis_name, num_microbatches=num_microbatches,
+        )
+        return out
+
+    def core_fwd(params, x):
+        out, stash = _pp_local_fwd(
+            stage_fn, params, x,
+            axis_name=axis_name, num_microbatches=num_microbatches,
+        )
+        return out, (params, stash)
+
+    def core_bwd(res, g):
+        params, stash = res
+        return _pp_local_bwd(
+            stage_fn, params, stash, g,
+            axis_name=axis_name, num_microbatches=num_microbatches,
+        )
+
+    core.defvjp(core_fwd, core_bwd)
+
+    def local(params, x):
+        # core's output is pp-varying (last stage real, zeros elsewhere);
+        # psum here — outside the custom_vjp — is the broadcast, and its
+        # transpose hands the full output cotangent to every stage.
+        return jax.lax.psum(core(params, x), axis_name)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+    )
+    return fn(stacked_params, x)
+
+
 def gpipe(
     stage_fn,
     stacked_params,
@@ -106,6 +291,7 @@ def gpipe(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = "pp",
+    param_specs=None,
 ):
     """Apply ``S`` stages to ``x`` as a GPipe pipeline over ``axis_name``.
 
@@ -114,11 +300,13 @@ def gpipe(
     stacked_params: pytree with leaves ``[S, ...]`` — stage-stacked weights,
         sharded ``P('pp')`` on the leading dim (logical axis ``stage``).
     x: ``[global_batch, ...]`` sharded over ``BATCH_AXES``.
+    param_specs: optional per-leaf specs (PP×TP; see :func:`one_f_one_b`).
 
     Returns stage_{S-1}(... stage_0(x)), sharded like ``x``.
     """
     S = mesh.shape[axis_name]
-    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     x_spec = P(BATCH_AXES)
     if S == 1:
         # Degenerate ring: identical math to the sequential oracle.

@@ -21,3 +21,23 @@ from __future__ import annotations
 def check_ring_shapes(seq_len: int, cp: int) -> None:
     if seq_len % cp:
         raise ValueError(f"ring: seq_len={seq_len} not divisible by cp={cp}")
+
+
+def ring_attention_fn(impl: str = "ring"):
+    """Select a ring implementation by name.
+
+    ``ring``        pure shard_map + lax.scan reference (the oracle);
+    ``ring_pallas`` same ring, per-visit block attention fused into a Pallas
+                    kernel — the production path on real TPU.
+    Both share the signature ``(q, k, v, mesh, *, causal=...)`` and sharding
+    contract (batch over BATCH_AXES, seq over 'cp', heads over 'tp').
+    """
+    if impl == "ring":
+        from ..ops.ring_attention import ring_attention
+
+        return ring_attention
+    if impl == "ring_pallas":
+        from ..ops.ring_attention_pallas import ring_attention_pallas
+
+        return ring_attention_pallas
+    raise ValueError(f"unknown ring impl {impl!r}")

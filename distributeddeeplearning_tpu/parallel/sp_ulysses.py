@@ -32,3 +32,24 @@ def check_ulysses_shapes(num_heads: int, seq_len: int, tp: int, cp: int) -> None
         )
     if seq_len % cp:
         raise ValueError(f"ulysses: seq_len={seq_len} not divisible by cp={cp}")
+
+
+def ulysses_reshard(q, k, v):
+    """Flip q/k/v from the ambient seq-sharded layout to the attention
+    layout: seq gathered, heads sharded over (tp, cp). Under a mesh with
+    cp > 1 the SPMD partitioner lowers this constraint pair to the Ulysses
+    all-to-alls (asserted on compiled HLO by ``tests/test_hlo_collectives``).
+    """
+    from ..sharding import constrain
+
+    f = lambda t: constrain(t, "batch", "seq_attn", "heads_attn", "kv")  # noqa: E731
+    return f(q), f(k), f(v)
+
+
+def ulysses_restore(out):
+    """Inverse flip after the attention core: back to seq-sharded."""
+    from ..sharding import constrain
+
+    return constrain(out, "batch", "seq", "heads", "kv")
+
+

@@ -22,12 +22,12 @@ and tools.
 
 from __future__ import annotations
 
-import math
-
-import jax
-from jax.sharding import NamedSharding
-
 from ..sharding import make_rules
+from .fsdp import (  # noqa: F401  (canonical home; re-exported for callers)
+    _spec_uses,
+    per_device_bytes,
+    sharded_fraction,
+)
 
 
 def tp_rules(sequence_parallel: bool = False):
@@ -36,48 +36,3 @@ def tp_rules(sequence_parallel: bool = False):
     if sequence_parallel:
         return make_rules(seq=("cp", "tp"))
     return make_rules()
-
-
-def sharded_fraction(tree, axis: str) -> float:
-    """Fraction of the tree's elements whose sharding uses ``axis``.
-
-    The load-bearing assertion for "is TP/FSDP actually on": parity tests can
-    pass with silently-replicated params, so tests also require
-    ``sharded_fraction(params, 'tp') > threshold``.
-    """
-    total = 0
-    sharded = 0
-    for leaf in jax.tree.leaves(tree):
-        n = math.prod(getattr(leaf, "shape", ()) or (1,))
-        total += n
-        s = getattr(leaf, "sharding", None)
-        # Naming the axis is not enough — over a size-1 mesh axis the spec
-        # entry is a placement no-op and the leaf is in fact replicated.
-        if (
-            isinstance(s, NamedSharding)
-            and _spec_uses(s.spec, axis)
-            and s.mesh.shape[axis] > 1
-        ):
-            sharded += n
-    return sharded / max(total, 1)
-
-
-def _spec_uses(spec, axis: str) -> bool:
-    for entry in spec:
-        axes = entry if isinstance(entry, tuple) else (entry,)
-        if axis in axes:
-            return True
-    return False
-
-
-def per_device_bytes(tree) -> int:
-    """Actual per-device HBM footprint of a sharded pytree (sum of addressable
-    shard bytes on device 0's shards)."""
-    total = 0
-    for leaf in jax.tree.leaves(tree):
-        if hasattr(leaf, "addressable_shards"):
-            shard = leaf.addressable_shards[0]
-            total += shard.data.nbytes
-        elif hasattr(leaf, "nbytes"):
-            total += leaf.nbytes
-    return total

@@ -24,6 +24,9 @@ Logical axis vocabulary used across the model zoo:
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+
 import jax
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding
@@ -46,6 +49,11 @@ DEFAULT_LOGICAL_RULES: tuple[tuple[str, str | tuple[str, ...] | None], ...] = (
     ("expert", "ep"),
     ("stage", "pp"),
     ("pos", None),
+    # Pipelined models' embedding/LM-head vocab dim: sharded over pp (on top
+    # of tp) so the table is NOT replicated per pipeline stage — each pp rank
+    # stores vocab/(tp*pp); XLA partitions the lookup gather and the tied
+    # attend matmul in the auto region (no pipeline involvement).
+    ("vocab_pp", ("tp", "pp")),
     # Inside-attention layout for Ulysses sequence parallelism: heads pick up
     # the cp axis (on top of tp) while seq is gathered; constraining q/k/v to
     # these makes the SPMD partitioner emit the seq<->heads all-to-alls.
@@ -96,9 +104,36 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# The mesh activation constraints resolve against. A package-local contextvar
+# (entered via ``activation_mesh``) rather than ``jax.sharding.set_mesh``:
+# flax's ``scope.param`` shape-validates every apply by eval_shape'ing the
+# init_fn, and DenseGeneral's init builds kernels flat-rank-2 before
+# reshaping — under a *global* mesh context the boxed rank-3 logical
+# constraint is applied to that flat value and tracing fails. Passing the
+# mesh explicitly into ``nn.with_logical_constraint`` sidesteps flax's
+# global-mesh path entirely while making the constraint just as real.
+_MESH_CTX: contextvars.ContextVar[Mesh | None] = contextvars.ContextVar(
+    "ddl_activation_mesh", default=None
+)
+
+
+@contextlib.contextmanager
+def activation_mesh(mesh: Mesh):
+    """Make ``constrain()`` resolve against ``mesh`` inside this context.
+
+    The Trainer enters this around every trace/compile/execute of its steps —
+    without it every activation-level constraint in the models is a silent
+    no-op (the round-2 Ulysses/Megatron-SP failure mode)."""
+    token = _MESH_CTX.set(mesh)
+    try:
+        yield
+    finally:
+        _MESH_CTX.reset(token)
+
+
 def constrain(x, *logical_axes, rules=None):
     """Constrain an activation's sharding by logical axis names (no-op outside
-    a mesh context). Used inside model code between blocks.
+    any mesh context). Used inside model code between blocks.
 
     Rules resolution: an ambient ``nn.logical_axis_rules(...)`` context (the
     Trainer installs its own rules around every model call) takes precedence;
@@ -107,4 +142,5 @@ def constrain(x, *logical_axes, rules=None):
     parameter shardings."""
     if rules is None:
         rules = nn.get_logical_axis_rules() or DEFAULT_LOGICAL_RULES
-    return nn.with_logical_constraint(x, P(*logical_axes), rules=rules)
+    mesh = _MESH_CTX.get()
+    return nn.with_logical_constraint(x, P(*logical_axes), rules=rules, mesh=mesh)

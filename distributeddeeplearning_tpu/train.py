@@ -30,7 +30,12 @@ from flax import linen as nn
 from flax import struct
 from jax.sharding import Mesh
 
-from .sharding import DEFAULT_LOGICAL_RULES, batch_sharding, logical_to_mesh_sharding
+from .sharding import (
+    DEFAULT_LOGICAL_RULES,
+    activation_mesh,
+    batch_sharding,
+    logical_to_mesh_sharding,
+)
 from .utils.rng import fold_in_step
 
 
@@ -156,7 +161,13 @@ def make_optimizer(
     elif name == "adamw_fused":
         from .ops.fused_adamw import fused_adamw
 
-        tx = fused_adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay)
+        # grad_clip handled inside the transformation (NOT an outer chain):
+        # a chain's tuple state would hide FusedAdamWState from the
+        # Trainer's shard_map dispatch (see Trainer._tx_update).
+        return fused_adamw(
+            sched, b1=b1, b2=b2, weight_decay=weight_decay,
+            grad_clip=grad_clip,
+        )
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     if grad_clip:
@@ -167,6 +178,37 @@ def make_optimizer(
 # ---------------------------------------------------------------------------
 # Trainer
 # ---------------------------------------------------------------------------
+
+
+class MeshedJit:
+    """A jitted function that traces/runs under the activation-mesh context.
+
+    Model code constrains activations via ``sharding.constrain``, which
+    resolves against :func:`sharding.activation_mesh`; without an active mesh
+    context every activation-level constraint in the models silently vanishes
+    (parameter shardings survive because they are passed explicitly via
+    in/out_shardings, but seq-parallel / Ulysses layouts live purely in
+    activation constraints — the round-2 silent-no-op failure). Entering the
+    context around the call makes the constraints real; ``lower`` is
+    forwarded under the same context so tests can assert collectives in the
+    compiled HLO.
+    """
+
+    def __init__(self, fn, mesh: Mesh):
+        self._fn = fn
+        self._mesh = mesh
+
+    def __call__(self, *args, **kwargs):
+        with activation_mesh(self._mesh):
+            return self._fn(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        with activation_mesh(self._mesh):
+            return self._fn.lower(*args, **kwargs)
+
+    def __getattr__(self, name):
+        # Forward everything else (e.g. _cache_size) to the jitted callable.
+        return getattr(self._fn, name)
 
 
 class Trainer:
@@ -262,6 +304,10 @@ class Trainer:
         materialization entirely.
         """
         self.setup(example_batch)
+        # NOT MeshedJit: placement comes from out_shardings, and flax's
+        # DenseGeneral initializes kernels flat-rank-2 before reshaping — an
+        # active mesh would apply the rank-3 logical constraint to the flat
+        # value and fail. Activation constraints only matter in the steps.
         init = jax.jit(
             lambda r: nn.meta.unbox(self._init_fn(r, self._example_inputs)),
             out_shardings=self.state_shardings,
@@ -307,6 +353,51 @@ class Trainer:
             loss = loss + aux_total
             metrics = {**metrics, "aux_loss": aux_total}
         return loss, (metrics, updates)
+
+    def _tx_update(self, grads, opt_state, params):
+        """Optimizer update; the fused Pallas AdamW runs under ``shard_map``.
+
+        A ``pallas_call`` is an opaque custom call, so in the auto-sharded
+        step the partitioner would all-gather every FSDP/ZeRO-sharded leaf
+        around it (ADVICE r1 #1/#2). The update is purely elementwise, so it
+        is instead run shard-local with specs taken from the *optimizer
+        state's* shardings: grads and params are resharded into the moment
+        layout (under ZeRO-1 that reshard IS the reduce-scatter), the kernel
+        updates local shards, and the delta leaves in the moment layout (the
+        step's params out_sharding turns that into the ZeRO-1 all-gather).
+        Chained transforms (e.g. global-norm clipping, whose state is not a
+        ``FusedAdamWState``) take the plain XLA path.
+        """
+        from .ops.fused_adamw import FusedAdamWState, _clip_by_global_norm
+
+        if not isinstance(opt_state, FusedAdamWState):
+            return self.tx.update(grads, opt_state, params)
+        clip = getattr(self.tx, "grad_clip", 0.0)
+        if clip:
+            # Clip here, in the auto-sharded region, where the global norm is
+            # computed over the true global grads; the (idempotent) clip
+            # inside update_fn then no-ops on the per-shard views.
+            grads = _clip_by_global_norm(grads, clip)
+        mu_specs = jax.tree.map(
+            lambda s: s.spec, self.state_shardings.opt_state.mu
+        )
+        state_specs = FusedAdamWState(
+            count=jax.sharding.PartitionSpec(),
+            mu=mu_specs,
+            nu=jax.tree.map(
+                lambda s: s.spec, self.state_shardings.opt_state.nu
+            ),
+        )
+        # check_vma=False: pallas_call inside shard_map (jax 0.9.0 vma-typing
+        # limitation, same as the ring/flash kernels); the body has no
+        # collectives — every shard's update is independent.
+        return jax.shard_map(
+            self.tx.update,
+            mesh=self.mesh,
+            in_specs=(mu_specs, state_specs, mu_specs),
+            out_specs=(mu_specs, state_specs),
+            check_vma=False,
+        )(grads, opt_state, params)
 
     def _make_train_step(self):
         def step_fn(state: TrainState, batch):
@@ -358,7 +449,7 @@ class Trainer:
                     self._loss_and_updates, has_aux=True
                 )(state.params, state.model_state, batch, rng, True)
 
-            updates_tx, new_opt_state = self.tx.update(
+            updates_tx, new_opt_state = self._tx_update(
                 grads, state.opt_state, state.params
             )
             new_params = optax.apply_updates(state.params, updates_tx)
@@ -371,11 +462,14 @@ class Trainer:
             return new_state, metrics
 
         donate = (0,) if self._donate else ()
-        return jax.jit(
-            step_fn,
-            in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
-            out_shardings=(self.state_shardings, None),
-            donate_argnums=donate,
+        return MeshedJit(
+            jax.jit(
+                step_fn,
+                in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
+                out_shardings=(self.state_shardings, None),
+                donate_argnums=donate,
+            ),
+            self.mesh,
         )
 
     @property
@@ -398,9 +492,12 @@ class Trainer:
                 )
                 return metrics
 
-            self._eval_step = jax.jit(
-                step_fn,
-                in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
+            self._eval_step = MeshedJit(
+                jax.jit(
+                    step_fn,
+                    in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
+                ),
+                self.mesh,
             )
         return self._eval_step
 

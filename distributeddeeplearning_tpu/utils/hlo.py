@@ -1,0 +1,30 @@
+"""Compiled-HLO inspection helpers.
+
+The defense against silent-replication regressions (round 2: two strategy
+rows passed every loss-parity test while emitting zero collectives): strategy
+tests compile their real train step and assert the program *does* what the
+strategy means — Ulysses emits all-to-alls, Megatron-SP the seq regather,
+ring its collective-permutes, EP its token exchange (see
+``tests/test_hlo_collectives.py``).
+"""
+
+from __future__ import annotations
+
+import re
+
+# Collective mnemonics as they appear in compiled HLO text. ``reduce-scatter``
+# may legitimately be absent on backends that lower it as
+# all-reduce + dynamic-slice (the CPU emitter does); tests therefore assert
+# on the gather side and on deltas vs a control compile.
+COLLECTIVE_KINDS: tuple[str, ...] = (
+    "all-to-all",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-reduce",
+)
+
+
+def collective_counts(hlo_text: str) -> dict[str, int]:
+    """Count collective ops in compiled HLO text."""
+    return {k: len(re.findall(k, hlo_text)) for k in COLLECTIVE_KINDS}

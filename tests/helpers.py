@@ -67,7 +67,8 @@ def train_tiny_gpt2(
     the mesh/sharding, which is what parity tests compare across."""
     model = models.get_model(
         "gpt2", size="tiny", vocab_size=256, max_len=64, dropout_rate=0.0,
-        attn_impl=attn_impl, mesh=mesh if attn_impl == "ring" else None,
+        attn_impl=attn_impl,
+        mesh=mesh if attn_impl in ("ring", "ring_pallas") else None,
     )
     ds = data_lib.SyntheticTokens(
         batch_size=batch_size, seq_len=seq_len, vocab_size=256, seed=0,

@@ -106,3 +106,88 @@ def test_trainer_integration(mesh1):
     batches = itertools.repeat(one)
     state, hist = fit(trainer, state, batches, steps=10, log_every=5)
     assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def _train_losses(mesh, opt_name, zero1=False, steps=4):
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.data import SyntheticTokens, sharded_batches
+    from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
+
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=256, max_len=64, dropout_rate=0.0
+    )
+    ds = SyntheticTokens(
+        batch_size=16, seq_len=32, vocab_size=256, seed=0, n_distinct=4
+    )
+    trainer = Trainer(
+        model, make_optimizer(opt_name, 1e-3, grad_clip=1.0),
+        get_task("lm"), mesh, donate=False, zero1=zero1,
+    )
+    state = trainer.init(0, ds.batch(0))
+    losses = []
+    for i, batch in enumerate(sharded_batches(ds, mesh)):
+        if i >= steps:
+            break
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+class TestShardedTrainerParity:
+    """The ADVICE-r1 sharding gap, closed: the fused update runs under
+    shard_map with the optimizer state's own specs (Trainer._tx_update), so
+    FSDP/ZeRO-sharded state is updated shard-locally instead of being
+    gathered around an opaque custom call. Parity is vs plain optax adamw
+    with the same grad clip on a single device."""
+
+    def test_fused_matches_adamw_on_dp_fsdp_tp(self, mesh1, mesh_factory):
+        ref = _train_losses(mesh1, "adamw")
+        fused = _train_losses(
+            mesh_factory(dp=2, fsdp=2, tp=2), "adamw_fused"
+        )
+        np.testing.assert_allclose(ref, fused, rtol=2e-4, atol=2e-5)
+
+    def test_fused_matches_adamw_under_zero1(self, mesh1, mesh_factory):
+        ref = _train_losses(mesh1, "adamw")
+        fused = _train_losses(
+            mesh_factory(dp=4, fsdp=2), "adamw_fused", zero1=True
+        )
+        np.testing.assert_allclose(ref, fused, rtol=2e-4, atol=2e-5)
+
+    def test_grad_clip_engages(self, mesh1):
+        # With an absurdly small clip the first-step update must differ from
+        # the unclipped run — guards against the clip being lost in the
+        # FusedAdamWTransformation plumbing.
+        from distributeddeeplearning_tpu import models
+        from distributeddeeplearning_tpu.data import SyntheticTokens
+        from distributeddeeplearning_tpu.train import (
+            Trainer,
+            get_task,
+            make_optimizer,
+        )
+
+        def one_step(clip):
+            model = models.get_model(
+                "gpt2", size="tiny", vocab_size=256, max_len=64,
+                dropout_rate=0.0,
+            )
+            ds = SyntheticTokens(batch_size=8, seq_len=32, vocab_size=256)
+            trainer = Trainer(
+                model, make_optimizer("adamw_fused", 1e-2, grad_clip=clip),
+                get_task("lm"), mesh1, donate=False,
+            )
+            state = trainer.init(0, ds.batch(0))
+            from distributeddeeplearning_tpu.data import sharded_batches
+
+            batch = next(iter(sharded_batches(ds.iter_from(0), mesh1)))
+            state, _ = trainer.train_step(state, batch)
+            return state.params
+
+        p_tiny = one_step(1e-4)
+        p_none = one_step(0.0)
+        diffs = jax.tree.leaves(
+            jax.tree.map(
+                lambda a, b: float(jnp.abs(a - b).max()), p_tiny, p_none
+            )
+        )
+        assert max(diffs) > 0.0

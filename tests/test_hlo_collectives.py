@@ -1,0 +1,131 @@
+"""Compiled-program assertions: each strategy must EMIT its collectives.
+
+Round-2 lesson: loss-parity tests pass even when a strategy silently
+degenerates to replication (the parity holds *because* nothing is sharded).
+These tests compile the real ``Trainer.train_step`` and assert on the HLO
+text — Ulysses must contain all-to-alls, Megatron-SP the seq regather,
+ring attention its KV rotation, TP its boundary reductions, EP its token
+exchange — each against a control compile on the same mesh so the assertion
+fails if (and only if) the strategy's constraints are deleted.
+"""
+
+import numpy as np
+
+from distributeddeeplearning_tpu import data as data_lib
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.utils.hlo import collective_counts
+from distributeddeeplearning_tpu.parallel.tp import tp_rules
+from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
+
+from helpers import mesh_of
+
+
+def compiled_step_text(mesh, model_name="gpt2", attn_impl="xla", rules=None,
+                       **model_kwargs):
+    """Compile the full train step (never a toy function — the round-2
+    no-ops were invisible precisely because only toys were inspected)."""
+    kwargs = dict(size="tiny", vocab_size=64, max_len=32, dropout_rate=0.0)
+    if model_name == "gpt2":
+        kwargs["attn_impl"] = attn_impl
+        kwargs["mesh"] = mesh if attn_impl in ("ring", "ring_pallas") else None
+    kwargs.update(model_kwargs)
+    model = models.get_model(model_name, **kwargs)
+    ds = data_lib.SyntheticTokens(
+        batch_size=16, seq_len=16, vocab_size=64, seed=0, n_distinct=4
+    )
+    kw = dict(donate=False)
+    if rules is not None:
+        kw["rules"] = rules
+    trainer = Trainer(
+        model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh, **kw
+    )
+    state = trainer.init(0, ds.batch(0))
+    batch = next(iter(data_lib.sharded_batches(ds, mesh)))
+    return trainer.train_step.lower(state, batch).compile().as_text()
+
+
+def test_ulysses_emits_all_to_all():
+    mesh = mesh_of(dp=2, cp=4)
+    control = collective_counts(compiled_step_text(mesh, attn_impl="xla"))
+    ulysses = collective_counts(compiled_step_text(mesh, attn_impl="ulysses"))
+    # The xla core on the same mesh performs no seq<->heads flip at all.
+    assert control["all-to-all"] == 0, control
+    assert ulysses["all-to-all"] > 0, ulysses
+
+
+def test_megatron_sp_emits_seq_regather():
+    mesh = mesh_of(dp=4, tp=2)
+    plain = collective_counts(
+        compiled_step_text(mesh, rules=tp_rules(sequence_parallel=False))
+    )
+    sp = collective_counts(
+        compiled_step_text(mesh, rules=tp_rules(sequence_parallel=True))
+    )
+    # Plain Megatron TP keeps activations replicated over tp: zero gathers,
+    # boundary psums only. Sharding seq over tp between blocks forces the
+    # partitioner to regather seq in front of every block's matmuls (the
+    # scatter side may lower as all-reduce + dynamic-slice on CPU, so the
+    # assertion anchors on the gathers).
+    assert plain["all-gather"] == 0, plain
+    assert sp["all-gather"] > 0, sp
+
+
+def test_tp_emits_boundary_reductions():
+    # TP's block-boundary psums come on top of the dp gradient all-reduces:
+    # same model on a pure-dp mesh is the control.
+    tp = collective_counts(compiled_step_text(mesh_of(dp=4, tp=2)))
+    dp = collective_counts(compiled_step_text(mesh_of(dp=8)))
+    assert tp["all-reduce"] > dp["all-reduce"], (tp, dp)
+
+
+def test_ring_emits_collective_permute():
+    mesh = mesh_of(dp=2, cp=4)
+    control = collective_counts(compiled_step_text(mesh, attn_impl="xla"))
+    ring = collective_counts(compiled_step_text(mesh, attn_impl="ring"))
+    assert ring["collective-permute"] > control["collective-permute"], (
+        ring, control,
+    )
+
+
+def test_ep_emits_token_exchange():
+    mesh = mesh_of(dp=2, ep=4)
+    moe = collective_counts(
+        compiled_step_text(
+            mesh, model_name="gpt2_moe", num_experts=4, moe_every=2,
+        )
+    )
+    # Token dispatch to ep-sharded experts and the combine back must move
+    # data across the ep axis: all-to-all, or its all-gather lowering.
+    assert moe["all-to-all"] + moe["all-gather"] > 0, moe
+
+
+def test_activation_mesh_contextvar_enters_and_resets():
+    # Pins the mechanism itself (set on entry, reset on exit, no leakage);
+    # the end-to-end effect is covered by the collective tests above and
+    # test_constrain_applies_inside_meshed_step below.
+    from distributeddeeplearning_tpu.sharding import _MESH_CTX, activation_mesh
+
+    mesh = mesh_of(dp=8)
+    assert _MESH_CTX.get() is None
+    with activation_mesh(mesh):
+        assert _MESH_CTX.get() is mesh
+    assert _MESH_CTX.get() is None
+
+
+def test_constrain_applies_inside_meshed_step():
+    # End-to-end: constrain() inside a MeshedJit-wrapped function actually
+    # shards (catching a regression where the contextvar is set but
+    # with_logical_constraint drops the mesh).
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from distributeddeeplearning_tpu.sharding import constrain
+    from distributeddeeplearning_tpu.train import MeshedJit
+
+    mesh = mesh_of(dp=4, fsdp=2)
+    fn = MeshedJit(jax.jit(lambda v: constrain(v, "batch", "embed")), mesh)
+    y = fn(jnp.ones((16, 4)))
+    assert isinstance(y.sharding, NamedSharding)
+    assert y.addressable_shards[0].data.shape[0] == 2
+    np.testing.assert_allclose(np.asarray(y), 1.0)

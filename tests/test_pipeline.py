@@ -14,6 +14,8 @@ from distributeddeeplearning_tpu.data import SyntheticTokens, sharded_batches
 from distributeddeeplearning_tpu.parallel.pp import (
     check_pipeline_shapes,
     gpipe,
+    gpipe_bubble_fraction,
+    one_f_one_b,
     sequential,
 )
 from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
@@ -70,15 +72,86 @@ class TestGpipeMechanism:
             check_pipeline_shapes(8, 2, 5, 4)
 
 
-def _train_losses(mesh, pipeline, steps=3, grad_accum=1, zero1=False):
+class TestOneFOneBMechanism:
+    """Mirror of TestGpipeMechanism for the 1F1B schedule (VERDICT r2 #5)."""
+
+    def test_forward_parity(self, mesh_factory):
+        mesh = mesh_factory(dp=2, pp=4)
+        stage_fn, params = _mlp_stages()
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+        y_seq = sequential(stage_fn, params, x)
+        y_pp = jax.jit(
+            lambda p, x: one_f_one_b(
+                stage_fn, p, x, mesh=mesh, num_microbatches=4
+            )
+        )(params, x)
+        np.testing.assert_allclose(y_seq, y_pp, atol=1e-6)
+
+    def test_grad_parity(self, mesh_factory):
+        mesh = mesh_factory(dp=2, pp=4)
+        stage_fn, params = _mlp_stages()
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+        g_seq = jax.grad(
+            lambda p, x: (sequential(stage_fn, p, x) ** 2).mean(),
+            argnums=(0, 1),
+        )(params, x)
+        g_pp = jax.jit(
+            jax.grad(
+                lambda p, x: (
+                    one_f_one_b(
+                        stage_fn, p, x, mesh=mesh, num_microbatches=2
+                    ) ** 2
+                ).mean(),
+                argnums=(0, 1),
+            )
+        )(params, x)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+            g_seq, g_pp,
+        )
+
+    def test_pp1_mesh_runs_sequentially(self, mesh1):
+        stage_fn, params = _mlp_stages()
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+        y_seq = sequential(stage_fn, params, x)
+        y_pp = one_f_one_b(stage_fn, params, x, mesh=mesh1, num_microbatches=2)
+        np.testing.assert_allclose(y_seq, y_pp, atol=1e-6)
+
+    def test_less_temp_memory_than_gpipe(self, mesh_factory):
+        # The schedule's point: 1F1B's residuals are per-microbatch stage
+        # INPUTS (+ recompute) while autodiff-GPipe saves every per-tick
+        # intermediate — measured on the compiled grad program at pp=4, M=8.
+        mesh = mesh_factory(pp=4)
+        stage_fn, params = _mlp_stages()
+        x = jax.random.normal(jax.random.PRNGKey(2), (16, 16))
+
+        def temp_bytes(engine):
+            f = lambda p, x: (  # noqa: E731
+                engine(stage_fn, p, x, mesh=mesh, num_microbatches=8) ** 2
+            ).sum()
+            compiled = jax.jit(jax.grad(f)).lower(params, x).compile()
+            return compiled.memory_analysis().temp_size_in_bytes
+
+        assert temp_bytes(one_f_one_b) < temp_bytes(gpipe)
+
+    def test_bubble_fraction(self):
+        assert gpipe_bubble_fraction(8, 4) == pytest.approx(3 / 11)
+        assert gpipe_bubble_fraction(1, 1) == 0.0
+
+
+def _train_losses(
+    mesh, pipeline, steps=3, grad_accum=1, zero1=False, num_stages=4,
+    schedule="gpipe",
+):
     model = models.get_model(
         "gpt2_pp",
         size="tiny",
         vocab_size=64,
         max_len=32,
-        num_stages=4,
+        num_stages=num_stages,
         num_microbatches=2,
         pipeline=pipeline,
+        schedule=schedule,
         mesh=mesh if pipeline else None,
     )
     trainer = Trainer(
@@ -110,6 +183,47 @@ class TestPipelinedModelParity:
             mesh_factory(dp=2, pp=4), pipeline=True, grad_accum=2, zero1=True
         )
         np.testing.assert_allclose(ref, pp, rtol=2e-5)
+
+    def test_pp4_1f1b_matches_sequential(self, mesh1, mesh_factory):
+        ref = _train_losses(mesh1, pipeline=False)
+        pp = _train_losses(
+            mesh_factory(dp=2, pp=4), pipeline=True, schedule="1f1b"
+        )
+        np.testing.assert_allclose(ref, pp, rtol=2e-5)
+
+    def test_pp2_tp2_composes(self, mesh1, mesh_factory):
+        # PP×TP: tp runs inside the stage (tp-sliced params + boundary
+        # psums) — previously an explicit non-feature (VERDICT r2 #5).
+        ref = _train_losses(mesh1, pipeline=False, num_stages=2)
+        for schedule in ("gpipe", "1f1b"):
+            pp = _train_losses(
+                mesh_factory(dp=2, pp=2, tp=2), pipeline=True,
+                num_stages=2, schedule=schedule,
+            )
+            np.testing.assert_allclose(ref, pp, rtol=2e-5)
+
+    def test_embedding_sharded_over_pp(self, mesh_factory):
+        # The GPipe-v1 replication tax is gone: the wte table (tied LM head)
+        # is stored split over pp ranks, not replicated per stage.
+        mesh = mesh_factory(dp=2, pp=4)
+        model = models.get_model(
+            "gpt2_pp", size="tiny", vocab_size=64, max_len=32,
+            num_stages=4, num_microbatches=2, mesh=mesh,
+        )
+        trainer = Trainer(
+            model, make_optimizer("adamw", 1e-2), get_task("lm"), mesh
+        )
+        ds = SyntheticTokens(batch_size=8, seq_len=16, vocab_size=64)
+        state = trainer.init(0, ds.batch(0))
+        emb = state.params["wte"]["embedding"]
+        spec = emb.sharding.spec
+        flat = [
+            a for e in spec if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))
+        ]
+        assert "pp" in flat, spec
+        # 4-way pp split on the vocab dim: local shard holds 1/4 the rows.
+        assert emb.addressable_shards[0].data.shape[0] == emb.shape[0] // 4
 
     def test_stage_mismatch_raises(self, mesh_factory):
         mesh = mesh_factory(dp=4, pp=2)

@@ -37,6 +37,28 @@ print("FLASH_TPU_OK")
     assert "FLASH_TPU_OK" in out
 
 
+def test_ring_attention_pallas_compiles_on_tpu():
+    # Single chip => cp=1: the ring is degenerate (zero rotations) but the
+    # fused per-visit block kernel compiles and runs for real on the v5e —
+    # the multi-device ring path is covered by the CPU-sim parity tests and
+    # the driver's dryrun_multichip.
+    out = run_on_tpu("""
+import jax, jax.numpy as jnp
+from distributeddeeplearning_tpu.mesh import single_device_mesh
+from distributeddeeplearning_tpu.ops import ring_attention_pallas, attention_reference
+assert jax.default_backend() == "tpu", jax.default_backend()
+mesh = single_device_mesh()
+qkv = [jax.random.normal(jax.random.PRNGKey(i), (2, 256, 4, 64), jnp.bfloat16)
+       for i in range(3)]
+out = jax.jit(lambda q, k, v: ring_attention_pallas(q, k, v, mesh, causal=True))(*qkv)
+ref = attention_reference(*qkv, causal=True)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+assert err < 0.05, err
+print("RING_PALLAS_TPU_OK")
+""")
+    assert "RING_PALLAS_TPU_OK" in out
+
+
 def test_fused_adamw_compiles_on_tpu():
     out = run_on_tpu("""
 import jax, jax.numpy as jnp, optax
