@@ -1,0 +1,54 @@
+"""Workload 2, real-data variant (``BASELINE.json:8``): ResNet-50 trained
+from an on-disk fixed-record image file with training augmentation.
+
+File format: CIFAR-style binary records (``label_bytes`` label bytes then
+``image_size^2 * channels`` uint8 pixels, chw), served by the C++ native
+loader with a numpy fallback. Augmentation (random pad+crop + horizontal
+flip) is a pure function of (seed, global sample index), so resume after a
+crash is step-exact and multi-host batches agree. Point ``data.eval_path``
+at a held-out validation file — eval always runs unaugmented.
+
+    python -m distributeddeeplearning_tpu.cli train \
+        --config configs/resnet50_imagenet_file.py \
+        --override data.path=train.bin --override data.eval_path=val.bin
+"""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(
+            name="resnet50", kwargs={"num_classes": 1000, "dtype": "bfloat16"}
+        ),
+        data=DataConfig(
+            kind="record_file_image",
+            batch_size=256,
+            image_size=224,
+            num_classes=1000,
+            path="",  # required: --override data.path=<train.bin>
+            label_bytes=2,  # 1000 classes
+            augment=True,
+            aug_pad=16,  # ~7% of 224 (the CIFAR-4-of-32 ratio)
+        ),
+        optim=OptimConfig(
+            name="sgd", lr=0.4, momentum=0.9, weight_decay=1e-4,
+            schedule="cosine", warmup_steps=1000,
+        ),
+        train=TrainConfig(
+            steps=450000,  # 90 epochs of 1.28M images at batch 256
+            log_every=50,
+            task="classification",
+            eval_every=5000,
+            save_every=5000,
+            checkpoint_dir="/tmp/resnet50_file_ckpt",
+        ),
+        mesh=MeshConfig(dp=-1),
+    )

@@ -76,19 +76,34 @@ def run_benchmark(
     state = trainer.init(cfg.train.seed, dataset.batch(0))
     n_params = tree_size(state.params)
 
-    batches = data_lib.prefetch(
-        data_lib.sharded_batches(dataset.iter_from(0), mesh), size=2
-    )
+    # Device-resident input: a few DISTINCT batches are staged in HBM before
+    # the timed window and cycled. The metric measures the training step, not
+    # the synthetic generator — host-side numpy generation + H2D through the
+    # PJRT tunnel costs seconds per 150MB batch and was gating the round-3
+    # first-chip measurement at ~0.7% MFU while the step itself was
+    # milliseconds. (Real-data input performance is the loader's own
+    # benchmark, not this one.)
+    n_staged = max(2, getattr(dataset, "n_distinct", 2))
+    it = data_lib.sharded_batches(dataset.iter_from(0), mesh)
+    staged = [next(it) for _ in range(n_staged)]
+    jax.block_until_ready(staged)
+
     step = trainer.train_step
-    for _ in range(warmup):
-        state, metrics = step(state, next(batches))
+    for i in range(warmup):
+        state, metrics = step(state, staged[i % n_staged])
+    # Fence: block_until_ready alone does not reliably drain through every
+    # PJRT plugin (observed on the tunneled backend); a scalar readback of
+    # the last step's metrics forces the whole dependency chain.
     jax.block_until_ready(state)
+    if warmup:
+        float(jax.tree.leaves(metrics)[0])
     compiles_after_warmup = step._cache_size()
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, next(batches))
+    for i in range(steps):
+        state, metrics = step(state, staged[i % n_staged])
     jax.block_until_ready(state)
+    float(jax.tree.leaves(metrics)[0])
     elapsed = time.perf_counter() - t0
 
     if step._cache_size() != compiles_after_warmup:
@@ -121,7 +136,7 @@ def run_benchmark(
     # MFU accounting (VERDICT.md next-round #2): per-device FLOPs of the
     # compiled step from XLA itself, achieved TFLOP/s over the timed window,
     # and utilization against the chip's bf16 peak when the kind is known.
-    flops = float(_step_cost_analysis(step, state, next(batches)).get("flops", 0.0))
+    flops = float(_step_cost_analysis(step, state, staged[0]).get("flops", 0.0))
     if flops > 0:
         achieved = flops * steps / elapsed / 1e12
         record["model_tflops_per_step"] = round(flops / 1e12, 4)

@@ -48,6 +48,12 @@ class DataConfig:
     path: str = ""  # record_file_image / token_file_*: data file
     num_threads: int = 2  # native loader worker threads
     prefetch_depth: int = 4  # native loader ring depth
+    # Vision training augmentation (record_file_image): deterministic
+    # random pad+crop / horizontal flip (data.augment_images). The eval
+    # split always runs with augmentation off.
+    augment: bool = False
+    aug_pad: int = 4
+    label_bytes: int = 1  # record_file_image: bytes per label (2 for >256 classes)
 
     def dataset_kwargs(self) -> dict[str, Any]:
         """Kwargs for this kind's dataset class: the intersection of its
@@ -68,6 +74,8 @@ class DataConfig:
         """Same as :meth:`dataset_kwargs` but on the eval split (see
         ``eval_seed`` / ``eval_path``)."""
         kwargs = self.dataset_kwargs()
+        if "augment" in kwargs:
+            kwargs["augment"] = False  # never augment the eval split
         if "path" in kwargs:  # file-backed kind
             if self.eval_path:
                 kwargs["path"] = self.eval_path

@@ -29,6 +29,72 @@ from .dataset_base import IndexedDataset  # noqa: F401  (re-export)
 from .sharding import batch_sharding
 
 
+_U64 = (1 << 64) - 1
+
+
+def _splitmix64_vec(x: np.ndarray) -> np.ndarray:
+    """Vectorized (uint64-array) splitmix64 finalizer — the numpy twin of
+    the int-domain ``native.loader._splitmix64`` (same constants; arrays
+    wrap silently where scalars would warn, hence two domains)."""
+    with np.errstate(over="ignore"):
+        x = (x + 0x9E3779B97F4A7C15).astype(np.uint64)
+        x = ((x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9))
+        x = ((x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB))
+        return x ^ (x >> np.uint64(31))
+
+
+def augment_bits(
+    seed: int, base_index: int, n: int, pad: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(dy, dx, flip) per sample, a pure function of the GLOBAL sample index
+    ``base_index + i`` and ``seed`` (splitmix64-mixed — vectorized, no
+    per-sample Generator objects)."""
+    from .native.loader import _splitmix64 as _splitmix64_int
+
+    idx = (np.arange(n, dtype=np.uint64) + np.uint64(base_index & _U64))
+    seed_mix = np.uint64(_splitmix64_int(seed & _U64))
+    h = _splitmix64_vec(idx ^ seed_mix)
+    span = np.uint64(2 * pad + 1)
+    dy = (h % span).astype(np.int64)
+    dx = ((h >> np.uint64(16)) % span).astype(np.int64)
+    flip = ((h >> np.uint64(32)) & np.uint64(1)).astype(bool)
+    return dy, dx, flip
+
+
+def augment_images(
+    images: np.ndarray,
+    *,
+    seed: int,
+    base_index: int,
+    pad: int = 4,
+    flip: bool = True,
+) -> np.ndarray:
+    """Random-crop (zero-pad ``pad`` then crop back) + horizontal flip.
+
+    Deterministic in ``(seed, base_index + i)`` per sample — augmentation is
+    a pure function of the sample's GLOBAL index, so step-exact resume and
+    multi-host batch agreement hold with augmentation on (the property the
+    whole input pipeline is built around; ``BASELINE.json:2`` "top-1 parity
+    at 90 epochs" is unreachable without this path). Host-side numpy on
+    ``[B, H, W, C]`` float images, fully vectorized (a per-sample Python
+    loop here would serially re-gate the input path the native loader's
+    thread pool exists to keep off step time).
+    """
+    b, h, w, c = images.shape
+    padded = np.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
+    )
+    dy, dx, do_flip = augment_bits(seed, base_index, b, pad)
+    rows = dy[:, None] + np.arange(h)[None, :]  # [B, H]
+    cols = dx[:, None] + np.arange(w)[None, :]  # [B, W]
+    out = padded[
+        np.arange(b)[:, None, None], rows[:, :, None], cols[:, None, :]
+    ]
+    if flip:
+        out[do_flip] = out[do_flip][:, :, ::-1]
+    return out
+
+
 @dataclasses.dataclass
 class SyntheticImages(IndexedDataset):
     """Deterministic random images + labels.

@@ -247,6 +247,11 @@ class RecordFileImages:
     seed: int = 0
     num_threads: int = 2
     prefetch_depth: int = 4
+    # Training augmentation (random pad+crop / horizontal flip), pure in
+    # (seed, global sample index) — see data.augment_images. The eval split
+    # always disables it (config.eval_dataset_kwargs).
+    augment: bool = False
+    aug_pad: int = 4
 
     def __post_init__(self):
         if not self.path:
@@ -303,13 +308,20 @@ class RecordFileImages:
         for b in range(self.label_bytes):
             label |= labels[:, b] << (8 * b)
         data = recs[:, self.label_bytes :].astype(np.float32) / 255.0
-        return self._pack(data, label)
+        return self._pack(data, label, index)
 
-    def _pack(self, data, labels):
-        return {
-            "image": _as_image(data, self.image_size, self.channels, self.layout),
-            "label": labels,
-        }
+    def _pack(self, data, labels, index: int):
+        image = _as_image(data, self.image_size, self.channels, self.layout)
+        if self.augment:
+            from ..data import augment_images
+
+            image = augment_images(
+                image,
+                seed=self.seed,
+                base_index=index * self.batch_size,
+                pad=self.aug_pad,
+            )
+        return {"image": image, "label": labels}
 
     def batch(self, index: int):
         if self._h is None:
@@ -317,7 +329,7 @@ class RecordFileImages:
         data = np.empty((self.batch_size, self._sample), np.float32)
         labels = np.empty((self.batch_size,), np.int32)
         self._h.fill(index, data, labels)
-        return self._pack(data, labels)
+        return self._pack(data, labels, index)
 
     def iter_from(self, start: int = 0):
         if self._h is None:
@@ -327,6 +339,7 @@ class RecordFileImages:
         self._gen += 1
         gen = self._gen
         self._h.start(start)
+        index = start
         while True:
             if self._gen != gen:
                 raise RuntimeError(
@@ -339,7 +352,8 @@ class RecordFileImages:
                 raise RuntimeError(
                     "native loader stream stopped (superseded or shutting down)"
                 )
-            yield self._pack(data, labels)
+            yield self._pack(data, labels, index)
+            index += 1
 
     def __iter__(self):
         return self.iter_from(0)

@@ -1,16 +1,20 @@
 """Fused AdamW update — Pallas kernel + optax-compatible wrapper.
 
 TPU-native equivalent of the reference's hand-written "CUDA optimizer
-step" (``BASELINE.json:5``): one VPU pass per parameter leaf reads
-(param, grad, m, v) and writes (delta, m', v') without intermediate HBM
-round-trips. XLA already fuses the optax elementwise chain well, so this
-kernel is an *optional* drop-in (``make_optimizer("adamw_fused", ...)``)
-— its value is pinning the fusion and the fp32 moment arithmetic
-explicitly, and serving as the template for further fused update rules.
+step" (``BASELINE.json:5``): ONE VPU pass over the whole parameter tree —
+all kernel-sized leaves are flattened into a single padded ``(rows, 128)``
+buffer per param dtype, so the step compiles one kernel variant and pays
+one launch instead of one per leaf (dozens of remote Mosaic compiles for
+GPT-2 otherwise). The trade: the per-step ``concatenate``/slice costs one
+extra HBM round trip of the p/g/m/v buffers around the kernel; storing the
+moments flat (so no per-step concat is needed) is the known next step. XLA
+already fuses the optax elementwise chain well, so this kernel is an
+*optional* drop-in (``make_optimizer("adamw_fused", ...)``) — its value is
+pinning the fusion and the fp32 moment arithmetic explicitly, and serving
+as the template for further fused update rules.
 
-Leaves are processed as padded ``(rows, 128)`` lane tiles; leaves smaller
-than one fp32 tile (8x128) stay on the plain-jnp path — a kernel launch
-per bias vector would cost more than it saves.
+Leaves smaller than one fp32 tile (8x128) stay on the plain-jnp path — a
+kernel's padding overhead per bias vector would cost more than it saves.
 """
 
 from __future__ import annotations
@@ -178,21 +182,60 @@ def fused_adamw(
         t = count.astype(jnp.float32)
         c1 = 1.0 / (1.0 - jnp.power(b1, t))
         c2 = 1.0 / (1.0 - jnp.power(b2, t))
-        out = jax.tree.map(
-            lambda p, g, m, v: _fused_leaf(
-                p, g, m, v, lr, c1, c2,
-                b1=b1, b2=b2, eps=eps, wd=weight_decay, interpret=ip,
-            ),
-            params, grads, state.mu, state.nu,
-        )
-        # Unzip the per-leaf (delta, m, v) triples by the params tree
-        # structure — duck-typing on tuples would misfire on params trees
-        # that themselves contain tuples.
+
+        # ONE kernel launch per param dtype: all kernel-sized leaves are
+        # flattened into a single (rows, 128) buffer. A per-leaf pallas_call
+        # would compile one kernel VARIANT per distinct leaf shape (~dozens
+        # for GPT-2) and pay a launch per leaf per step; concatenation is
+        # shard-local, so this composes unchanged with the Trainer's
+        # shard_map dispatch over ZeRO/FSDP-sharded state.
         treedef = jax.tree.structure(params)
-        triples = treedef.flatten_up_to(out)
-        unzip = lambda i: treedef.unflatten([t[i] for t in triples])  # noqa: E731
-        return unzip(0), FusedAdamWState(
-            count=count, mu=unzip(1), nu=unzip(2)
+        p_leaves = jax.tree.leaves(params)
+        g_leaves = jax.tree.leaves(grads)
+        m_leaves = jax.tree.leaves(state.mu)
+        v_leaves = jax.tree.leaves(state.nu)
+        n = len(p_leaves)
+        deltas: list = [None] * n
+        nms: list = [None] * n
+        nvs: list = [None] * n
+
+        groups: dict = {}
+        for i, p in enumerate(p_leaves):
+            if p.size < _MIN_KERNEL_SIZE:
+                # A kernel launch per bias vector costs more than it saves.
+                gf = g_leaves[i].astype(jnp.float32)
+                m2 = b1 * m_leaves[i] + (1.0 - b1) * gf
+                v2 = b2 * v_leaves[i] + (1.0 - b2) * gf * gf
+                deltas[i] = (
+                    -lr * (m2 * c1 / (jnp.sqrt(v2 * c2) + eps)
+                           + weight_decay * p.astype(jnp.float32))
+                ).astype(p.dtype)
+                nms[i], nvs[i] = m2, v2
+            else:
+                groups.setdefault(jnp.dtype(p.dtype), []).append(i)
+
+        for dtype, idxs in groups.items():
+            flat = lambda leaves: jnp.concatenate(  # noqa: E731
+                [leaves[i].reshape(-1) for i in idxs]
+            )
+            d_f, nm_f, nv_f = _fused_leaf(
+                flat(p_leaves), flat(g_leaves), flat(m_leaves), flat(v_leaves),
+                lr, c1, c2,
+                b1=b1, b2=b2, eps=eps, wd=weight_decay, interpret=ip,
+            )
+            off = 0
+            for i in idxs:
+                sz = p_leaves[i].size
+                shape = p_leaves[i].shape
+                deltas[i] = d_f[off : off + sz].reshape(shape)
+                nms[i] = nm_f[off : off + sz].reshape(shape)
+                nvs[i] = nv_f[off : off + sz].reshape(shape)
+                off += sz
+
+        return treedef.unflatten(deltas), FusedAdamWState(
+            count=count,
+            mu=treedef.unflatten(nms),
+            nu=treedef.unflatten(nvs),
         )
 
     return FusedAdamWTransformation(init_fn, update_fn, grad_clip)

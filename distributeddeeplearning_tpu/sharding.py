@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import warnings
 
 import jax
 from flax import linen as nn
@@ -129,6 +130,75 @@ def activation_mesh(mesh: Mesh):
         yield
     finally:
         _MESH_CTX.reset(token)
+
+
+def _rule_axes(rules_table: dict, name) -> tuple[str, ...]:
+    entry = rules_table.get(name)
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def validate_logical_spec(
+    logical_names, shape, rules, mesh: Mesh, *, what: str = "array"
+) -> None:
+    """Validate one array's logical annotation against a rules table + mesh.
+
+    Raises ``ValueError`` when the rules map two dims of the same array onto
+    one mesh axis (flax silently DROPS the colliding rule — round 2 showed
+    how silently-weaker sharding survives parity tests); warns loudly when a
+    sharded dim is not divisible by its mesh-axis product (XLA pads —
+    correct but wasteful, and byte accounting drifts). Checked
+    property-style across every legal mesh × zoo model in
+    ``tests/test_sharding_properties.py``.
+    """
+    table = dict(rules)
+    seen: dict[str, object] = {}
+    for dim, name in enumerate(logical_names):
+        axes = _rule_axes(table, name)
+        for axis in axes:
+            if axis not in mesh.shape:
+                raise ValueError(
+                    f"{what}: logical axis {name!r} maps to unknown mesh "
+                    f"axis {axis!r}"
+                )
+            if axis in seen and mesh.shape[axis] > 1:
+                raise ValueError(
+                    f"{what}: mesh axis {axis!r} assigned to two dims "
+                    f"(logical {seen[axis]!r} and {name!r}) — flax would "
+                    "silently drop one"
+                )
+            seen[axis] = name
+        ways = 1
+        for axis in axes:
+            ways *= mesh.shape[axis]
+        if ways > 1 and shape[dim] % ways:
+            # Warning, not error: XLA pads uneven shards correctly (an odd
+            # vocab like GPT-2's 50257 over tp/pp is routine); the cost is
+            # wasted HBM/compute on the padding and byte-accounting drift,
+            # which deserves a loud signal but must not block training.
+            warnings.warn(
+                f"{what}: dim {dim} (logical {name!r}, size {shape[dim]}) "
+                f"not divisible by its {ways}-way sharding — XLA will pad",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+
+def validate_tree_shardings(abs_tree, mesh: Mesh, rules=DEFAULT_LOGICAL_RULES):
+    """Run :func:`validate_logical_spec` over every ``nn.Partitioned`` leaf
+    of an abstract (eval_shape'd) variable tree."""
+    def check(path, leaf):
+        if isinstance(leaf, nn.Partitioned):
+            validate_logical_spec(
+                leaf.names, leaf.value.shape, rules, mesh,
+                what=jax.tree_util.keystr(path),
+            )
+        return leaf
+
+    jax.tree_util.tree_map_with_path(
+        check, abs_tree, is_leaf=lambda l: isinstance(l, nn.Partitioned)
+    )
 
 
 def constrain(x, *logical_axes, rules=None):

@@ -35,6 +35,7 @@ from .sharding import (
     activation_mesh,
     batch_sharding,
     logical_to_mesh_sharding,
+    validate_tree_shardings,
 )
 from .utils.rng import fold_in_step
 
@@ -281,6 +282,11 @@ class Trainer:
             lambda r: self._init_fn(r, self._example_inputs),
             jax.random.PRNGKey(0),
         )
+        # Refuse silently-weaker sharding up front: a rules/mesh combination
+        # that double-assigns a mesh axis on one array (flax would drop the
+        # rule) or shards an indivisible dim (XLA would pad) fails HERE with
+        # a named leaf, not as a quietly-replicated training run.
+        validate_tree_shardings(abs_state, self.mesh, self.rules)
         specs = nn.get_partition_spec(abs_state)
         self.abstract_state = nn.meta.unbox(abs_state)
         self.state_shardings = logical_to_mesh_sharding(specs, self.mesh, self.rules)

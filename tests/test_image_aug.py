@@ -1,0 +1,149 @@
+"""Vision augmentation (random pad+crop / flip) — determinism + training
+path (VERDICT r2 Next #7; ``BASELINE.json:2`` "top-1 parity at 90 epochs"
+needs real-image training with augmentation).
+"""
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.data import augment_images, make_dataset
+from distributeddeeplearning_tpu.native.loader import RecordFileImages
+
+from test_native_loader import _write_records
+
+
+def _images(b=4, h=8, w=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.random((b, h, w, c), np.float32)
+
+
+class TestAugmentImages:
+    def test_deterministic_in_seed_and_index(self):
+        imgs = _images()
+        a = augment_images(imgs, seed=7, base_index=32)
+        b = augment_images(imgs, seed=7, base_index=32)
+        np.testing.assert_array_equal(a, b)
+
+    def test_different_index_changes_augmentation(self):
+        # With pad=4 on 8x8 there are 81 crop offsets x 2 flips per sample;
+        # 4 samples differing somewhere is overwhelmingly likely, and the
+        # counter-based bits make it reproducible — no flake.
+        imgs = _images()
+        a = augment_images(imgs, seed=7, base_index=0)
+        b = augment_images(imgs, seed=7, base_index=1000)
+        assert not np.array_equal(a, b)
+
+    def test_per_sample_not_per_batch_randomness(self):
+        # Two identical samples in one batch must get different crops
+        # (otherwise it's batch-level augmentation in disguise).
+        one = _images(b=1)
+        imgs = np.concatenate([one] * 8)
+        out = augment_images(imgs, seed=3, base_index=0)
+        assert any(
+            not np.array_equal(out[0], out[i]) for i in range(1, 8)
+        )
+
+    def test_crop_is_a_shifted_window_of_padded_image(self):
+        # Manually recompute sample 0's transform from the same bit stream.
+        from distributeddeeplearning_tpu.data import augment_bits
+
+        imgs = _images(b=1, h=8, w=8)
+        pad = 2
+        out = augment_images(imgs, seed=11, base_index=5, pad=pad)
+        dy, dx, flip = augment_bits(11, 5, 1, pad)
+        padded = np.pad(
+            imgs[0], ((pad, pad), (pad, pad), (0, 0)), mode="constant"
+        )
+        expect = padded[int(dy[0]) : int(dy[0]) + 8, int(dx[0]) : int(dx[0]) + 8]
+        if flip[0]:
+            expect = expect[:, ::-1]
+        np.testing.assert_array_equal(out[0], expect)
+
+    def test_zero_index_batch_boundary_continuity(self):
+        # base_index is a GLOBAL sample index: batch k at batch_size B must
+        # equal samples [kB, (k+1)B) — slicing invariance.
+        imgs = _images(b=8)
+        whole = augment_images(imgs, seed=1, base_index=0)
+        first = augment_images(imgs[:4], seed=1, base_index=0)
+        second = augment_images(imgs[4:], seed=1, base_index=4)
+        np.testing.assert_array_equal(whole, np.concatenate([first, second]))
+
+
+class TestRecordFileAugmentation:
+    def test_batch_pure_in_index_with_augmentation(self, tmp_path):
+        path = str(tmp_path / "recs.bin")
+        _write_records(path, n=32, size=8)
+        ds1 = RecordFileImages(
+            path=path, batch_size=8, image_size=8, augment=True, seed=5
+        )
+        ds2 = RecordFileImages(
+            path=path, batch_size=8, image_size=8, augment=True, seed=5
+        )
+        for i in (0, 3, 7):
+            a, b = ds1.batch(i), ds2.batch(i)
+            np.testing.assert_array_equal(a["image"], b["image"])
+            np.testing.assert_array_equal(a["label"], b["label"])
+        # iter_from agrees with random access (step-exact resume property).
+        it = ds1.iter_from(2)
+        np.testing.assert_array_equal(next(it)["image"], ds2.batch(2)["image"])
+
+    def test_augment_changes_pixels_but_not_labels(self, tmp_path):
+        path = str(tmp_path / "recs.bin")
+        _write_records(path, n=32, size=8)
+        plain = RecordFileImages(
+            path=path, batch_size=8, image_size=8, augment=False, seed=5
+        )
+        aug = RecordFileImages(
+            path=path, batch_size=8, image_size=8, augment=True, seed=5
+        )
+        a, p = aug.batch(0), plain.batch(0)
+        np.testing.assert_array_equal(a["label"], p["label"])
+        assert not np.array_equal(a["image"], p["image"])
+
+    def test_config_plumbs_augment_and_eval_disables_it(self, tmp_path):
+        from distributeddeeplearning_tpu.config import DataConfig
+
+        path = str(tmp_path / "recs.bin")
+        _write_records(path, n=32, size=8)
+        dc = DataConfig(
+            kind="record_file_image", batch_size=8, image_size=8,
+            path=path, eval_path=path, augment=True,
+        )
+        assert dc.dataset_kwargs()["augment"] is True
+        assert dc.eval_dataset_kwargs()["augment"] is False
+        train_ds = make_dataset(dc.kind, **dc.dataset_kwargs())
+        eval_ds = make_dataset(dc.kind, **dc.eval_dataset_kwargs())
+        assert not np.array_equal(
+            train_ds.batch(0)["image"], eval_ds.batch(0)["image"]
+        )
+
+    def test_resnet_trains_from_augmented_file(self, tmp_path):
+        # The VERDICT-defined done-bar: a resnet config trains from an
+        # on-disk image file with augmentation (tiny scale here; resume
+        # step-exactness follows from batch(i) purity asserted above).
+        from distributeddeeplearning_tpu import models
+        from distributeddeeplearning_tpu.data import sharded_batches
+        from distributeddeeplearning_tpu.train import (
+            Trainer,
+            get_task,
+            make_optimizer,
+        )
+
+        from helpers import mesh_of
+
+        path = str(tmp_path / "recs.bin")
+        _write_records(path, n=64, size=8)
+        ds = RecordFileImages(
+            path=path, batch_size=16, image_size=8, augment=True, seed=0
+        )
+        mesh = mesh_of(dp=2)
+        trainer = Trainer(
+            models.get_model("resnet18", num_classes=10),
+            make_optimizer("sgd", 0.05), get_task("classification"), mesh,
+        )
+        state = trainer.init(0, ds.batch(0))
+        losses = []
+        for i, batch in zip(range(4), sharded_batches(ds.iter_from(0), mesh)):
+            state, metrics = trainer.train_step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(losses))
