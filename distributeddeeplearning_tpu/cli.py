@@ -198,9 +198,23 @@ def main(argv=None) -> int:
             metavar="a.b=v",
             help="dotted config override (repeatable)",
         )
+        p.add_argument(
+            "--xla-perf-flags",
+            action="store_true",
+            help="apply mesh.XLA_PERF_FLAGS (async-collective overlap) "
+            "before backend init",
+        )
     args = parser.parse_args(argv)
+    if args.xla_perf_flags:
+        # Env-level, so it must precede EVERY backend touch — including the
+        # rendezvous below and anything a config module might do.
+        from .mesh import apply_xla_perf_flags
+
+        print(f"XLA_FLAGS: {apply_xla_perf_flags()}")
     # Multi-host rendezvous (no-op single-process); must precede any
-    # backend/device use.
+    # backend/device use — in particular it runs BEFORE the config module
+    # (an arbitrary .py) executes, so a config that calls
+    # jax.device_count() sees the global device view.
     init_distributed()
     cfg = apply_overrides(load_config(args.config), args.override)
     if args.cmd == "train":

@@ -124,6 +124,9 @@ class TrainConfig:
     fault_injection: str = ""  # "step:K" -> hard-kill the process at step K
     debug_nans: bool = False  # jax_debug_nans: fail fast on NaN outputs
     debug_checks: bool = False  # jax_enable_checks: internal invariants
+    # (async-collective XLA flags are a CLI switch, --xla-perf-flags, not a
+    # config field: they must hit the environment before the config module —
+    # an arbitrary .py — could touch the backend.)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -202,6 +202,70 @@ def init_distributed(
     return True
 
 
+# Latency-hiding / async-collective XLA flags (SURVEY §2c "Overlap" row —
+# the TPU counterpart of NCCL stream overlap). Public flags from the TPU
+# scaling playbooks; exact availability varies by XLA build, so application
+# is OPT-IN (config.train.xla_perf_flags) and happens via the environment
+# BEFORE backend init — XLA rejects unknown flags loudly rather than
+# silently ignoring them, which is the behavior we want when a build drifts.
+XLA_PERF_FLAGS: tuple[str, ...] = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+)
+
+
+def apply_xla_perf_flags(
+    flags: tuple[str, ...] = XLA_PERF_FLAGS, probe_timeout_s: int = 180
+) -> str:
+    """Append the perf flags to ``XLA_FLAGS`` (idempotent) IF this runtime
+    accepts them. Must run before the first backend touch.
+
+    Flag registries differ per PJRT plugin (``--xla_tpu_*`` only exists on
+    TPU runtimes) and XLA ABORTS the process on unknown ``XLA_FLAGS`` —
+    so acceptance is probed in a throwaway subprocess first; on rejection
+    or probe timeout the environment is left untouched and a warning names
+    the rejected set. Returns the final ``XLA_FLAGS`` value for logging."""
+    import os
+    import subprocess
+    import sys
+
+    current = os.environ.get("XLA_FLAGS", "")
+    parts = current.split()
+    for f in flags:
+        name = f.split("=", 1)[0]
+        if not any(p.split("=", 1)[0] == name for p in parts):
+            parts.append(f)
+    candidate = " ".join(parts)
+    if candidate == current:
+        return current
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = candidate
+    try:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.jit(lambda x: x + 1)(1)"],
+                env=env, capture_output=True, timeout=probe_timeout_s,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        warnings.warn(
+            f"this runtime rejected the XLA perf flags {flags}; leaving "
+            "XLA_FLAGS unchanged",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return current
+    os.environ["XLA_FLAGS"] = candidate
+    return candidate
+
+
 def single_device_mesh(device=None) -> Mesh:
     """All-axes-size-1 mesh on one device (the unsharded baseline for parity
     tests and the single-chip path)."""

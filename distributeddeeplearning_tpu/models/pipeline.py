@@ -100,10 +100,10 @@ class PipelinedTransformerStack(nn.Module):
     def __call__(self, x, mask=None, deterministic: bool = True):
         if mask is not None:
             raise NotImplementedError("pipelined stack supports mask=None only")
-        if self.schedule not in ("gpipe", "1f1b"):
+        if self.schedule not in ("gpipe", "1f1b", "1f1b_interleaved"):
             raise ValueError(
                 f"unknown pipeline schedule {self.schedule!r}; "
-                "expected 'gpipe' or '1f1b'"
+                "expected 'gpipe', '1f1b' or '1f1b_interleaved'"
             )
         if self.dropout_rate and not deterministic:
             raise NotImplementedError(
@@ -215,7 +215,15 @@ class PipelinedTransformerStack(nn.Module):
                     abs_stacked,
                     is_leaf=lambda l: isinstance(l, nn.Partitioned),
                 )
-            engine = {"gpipe": gpipe, "1f1b": one_f_one_b}[self.schedule]
+            # '1f1b_interleaved' training runs through the grads-inside
+            # engine (Trainer dispatches to pipeline_value_and_grad); this
+            # __call__ path then only serves init/eval, where the forward
+            # schedules are equivalent — use gpipe's.
+            engine = {
+                "gpipe": gpipe,
+                "1f1b": one_f_one_b,
+                "1f1b_interleaved": gpipe,
+            }[self.schedule]
             return engine(
                 stage_fn,
                 stacked,
@@ -242,6 +250,23 @@ class PipelinedGPT2(nn.Module):
     schedule: str = "gpipe"  # gpipe | 1f1b
     dtype: jnp.dtype = jnp.float32
     mesh: object = None
+
+    # ONE architecture definition shared by __call__ (init/eval) and
+    # pipeline_value_and_grad (interleaved training): a drift between the
+    # two would silently train a different model than the one evaluated.
+    _LN_EPS = 1e-5
+
+    def _arch(self) -> dict:
+        return dict(
+            num_heads=self.num_heads,
+            head_dim=self.embed_dim // self.num_heads,
+            mlp_dim=4 * self.embed_dim,
+            pre_ln=True,
+            causal=True,
+            activation="gelu_tanh",
+            ln_eps=self._LN_EPS,
+            dtype=self.dtype,
+        )
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -275,22 +300,88 @@ class PipelinedGPT2(nn.Module):
             num_layers=self.num_layers,
             num_stages=self.num_stages,
             num_microbatches=self.num_microbatches,
-            num_heads=self.num_heads,
-            head_dim=self.embed_dim // self.num_heads,
-            mlp_dim=4 * self.embed_dim,
-            pre_ln=True,
-            causal=True,
-            activation="gelu_tanh",
-            ln_eps=1e-5,
-            dtype=self.dtype,
             pipeline=self.pipeline,
             schedule=self.schedule,
             mesh=self.mesh,
             name="h",
+            **self._arch(),
         )(x, None, not train)
-        x = layer_norm(1e-5, self.dtype, "ln_f")(x)
+        x = layer_norm(self._LN_EPS, self.dtype, "ln_f")(x)
         logits = wte.attend(x)
         return logits.astype(jnp.float32)
+
+    # -- true interleaved 1F1B (schedule='1f1b_interleaved') ---------------
+
+    def pipeline_value_and_grad(self, params, batch, mesh):
+        """(loss, grads) via :func:`parallel.pp.interleaved_1f1b` — the
+        engine owns the schedule AND differentiation, so the Trainer calls
+        this instead of ``jax.value_and_grad`` (see ``Trainer``). Causal-LM
+        batches only (``batch['tokens']``); dropout and PP×TP are not
+        supported on this path (use schedule='1f1b' for PP×TP)."""
+        import optax
+
+        from ..parallel.pp import interleaved_1f1b
+
+        if mesh.shape["tp"] > 1:
+            raise NotImplementedError(
+                "schedule='1f1b_interleaved' does not compose with tp>1 "
+                "yet; use schedule='1f1b'"
+            )
+        # parent=None: inside a module method flax would auto-adopt these as
+        # children of self (whose scope is unbound here) — they are
+        # standalone appliers over param subtrees, not submodules. Block
+        # architecture comes from the SAME _arch() dict __call__ uses.
+        stage_mod = PipelineStage(
+            self.num_layers // self.num_stages,
+            parent=None,
+            **self._arch(),
+        )
+        wte_mod = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype, parent=None
+        )
+        wpe_mod = nn.Embed(
+            self.max_len, self.embed_dim, dtype=self.dtype, parent=None
+        )
+        ln_mod = nn.LayerNorm(
+            epsilon=self._LN_EPS, dtype=self.dtype, parent=None
+        )
+
+        def embed_fn(shared, bm):
+            tok = bm["tokens"][:, :-1]
+            x = wte_mod.apply({"params": shared["wte"]}, tok)
+            pos = wpe_mod.apply(
+                {"params": shared["wpe"]}, jnp.arange(tok.shape[1])[None, :]
+            )
+            return (x + pos).astype(self.dtype)
+
+        def stage_fn(stage_params, y):
+            with nn.logical_axis_rules(()):
+                return stage_mod.apply({"params": stage_params}, y, True)
+
+        def head_fn(shared, y, bm):
+            x = ln_mod.apply({"params": shared["ln_f"]}, y)
+            logits = wte_mod.apply(
+                {"params": shared["wte"]}, x, method="attend"
+            ).astype(jnp.float32)
+            targets = bm["tokens"][:, 1:]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+
+        stacked = params["h"]["stages"]
+        shared = {k: params[k] for k in ("wte", "wpe", "ln_f")}
+        loss, (dstacked, dshared) = interleaved_1f1b(
+            embed_fn, stage_fn, head_fn, stacked, shared,
+            {"tokens": batch["tokens"]},
+            mesh=mesh, num_microbatches=self.num_microbatches,
+        )
+        grads = {
+            "wte": dshared["wte"],
+            "wpe": dshared["wpe"],
+            "ln_f": dshared["ln_f"],
+            "h": {"stages": dstacked},
+        }
+        return loss, grads
 
 
 @register("gpt2_pp")

@@ -32,11 +32,14 @@ Schedules:
   *inputs*; the backward runs the 1F1B reverse pipeline (stage ``s`` does
   the backward of microbatch ``m`` as soon as stage ``s+1`` hands it the
   cotangent, recomputing the stage forward from the stashed input). This is
-  1F1B-with-remat's backward ordering and memory profile; the
-  loss-inside-the-schedule variant (true interleaved fwd/bwd, which would
-  need the Trainer to delegate grad computation to the pipeline) is the
-  known next step. Peak-memory win vs gpipe is asserted by
-  ``tests/test_pipeline.py`` via compiled memory analysis.
+  1F1B-with-remat's backward ordering and memory profile under plain
+  ``jax.grad``, and it composes with PP×TP. Peak-memory win vs gpipe is
+  asserted by ``tests/test_pipeline.py`` via compiled memory analysis.
+- ``1f1b_interleaved`` (:func:`interleaved_1f1b`) — TRUE 1F1B: the engine
+  owns loss AND differentiation, every tick runs one forward and one
+  backward unit, and the activation stash is a circular buffer of depth
+  ``2S`` (pipeline depth) instead of ``M`` (microbatch count). The Trainer
+  dispatches to ``model.pipeline_value_and_grad`` for this schedule.
 
 Composability: batch axes (``dp``/``fsdp``) pass straight through the
 ``shard_map`` specs, so PP x DP works out of the box. PP x TP runs tensor
@@ -97,10 +100,11 @@ def _gpipe_local(stage_fn, params, x, *, axis_name: str, num_microbatches: int):
         x_in = jnp.where(stage == 0, mb[jnp.minimum(t, M - 1)], state_in)
         y = stage_fn(params, x_in)
         out_t = t - (S - 1)  # which microbatch the LAST stage just finished
-        outputs = jnp.where(
-            (stage == S - 1) & (out_t >= 0),
-            outputs.at[jnp.clip(out_t, 0, M - 1)].set(y),
-            outputs,
+        # Single-slot masked write keeps the scan carry in place.
+        out_i = jnp.clip(out_t, 0, M - 1)
+        out_ok = (stage == S - 1) & (out_t >= 0)
+        outputs = outputs.at[out_i].set(
+            jnp.where(out_ok, y, outputs[out_i])
         )
         state_next = jax.lax.ppermute(y, axis_name, perm)
         return (state_next, outputs), None
@@ -139,13 +143,14 @@ def _pp_local_fwd(stage_fn, params, x, *, axis_name, num_microbatches):
         valid = (m >= 0) & (m < M)
         m_idx = jnp.clip(m, 0, M - 1)
         x_in = jnp.where(stage == 0, mb[jnp.minimum(t, M - 1)], state_in)
-        stash = jnp.where(valid, stash.at[m_idx].set(x_in), stash)
+        # Single-slot masked writes (not whole-buffer selects) keep the scan
+        # carry updating in place.
+        stash = stash.at[m_idx].set(jnp.where(valid, x_in, stash[m_idx]))
         y = stage_fn(params, x_in)
-        out_t = t - (S - 1)
-        outputs = jnp.where(
-            (stage == S - 1) & (out_t >= 0),
-            outputs.at[jnp.clip(out_t, 0, M - 1)].set(y),
-            outputs,
+        out_i = jnp.clip(t - (S - 1), 0, M - 1)
+        out_ok = (stage == S - 1) & (t - (S - 1) >= 0)
+        outputs = outputs.at[out_i].set(
+            jnp.where(out_ok, y, outputs[out_i])
         )
         state_next = jax.lax.ppermute(y, axis_name, perm)
         return (state_next, outputs, stash), None
@@ -196,8 +201,8 @@ def _pp_local_bwd(stage_fn, params, stash, g, *, axis_name, num_microbatches):
             lambda a, b: a + jnp.where(valid, b, jnp.zeros_like(b)),
             dparams, dp,
         )
-        dx_out = jnp.where(
-            (stage == 0) & valid, dx_out.at[m_idx].set(dxi), dx_out
+        dx_out = dx_out.at[m_idx].set(
+            jnp.where((stage == 0) & valid, dxi, dx_out[m_idx])
         )
         send = jnp.where(valid, dxi, jnp.zeros_like(dxi))
         recv = jax.lax.ppermute(send, axis_name, perm_back)
@@ -281,6 +286,227 @@ def one_f_one_b(
         out_specs=x_spec,
     )
     return fn(stacked_params, x)
+
+
+def interleaved_1f1b(
+    embed_fn,
+    stage_fn,
+    head_fn,
+    stacked_params,
+    shared_params,
+    batch,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    param_specs=None,
+):
+    """TRUE interleaved 1F1B: loss inside the schedule, grads out.
+
+    Unlike :func:`one_f_one_b` (a custom_vjp whose backward replays the
+    reverse pipeline after ``jax.grad`` calls it), this engine owns the whole
+    training step's differentiation: at every tick each stage runs one
+    forward unit AND one backward unit, the last stage computes the
+    microbatch loss + output cotangent the same tick its forward finishes,
+    and cotangents chase activations down the ring with a lag of one tick
+    per stage. Consequences:
+
+    - activation stash is a CIRCULAR buffer of depth ``2S`` (pipeline
+      depth), not ``M`` (microbatch count) — the memory bound that defines
+      1F1B; an input's lifetime is at most ``2(S-1)+1`` ticks, so slots
+      recycle safely for any ``M``;
+    - total ticks ``M + 2(S-1)``: the steady state really is
+      one-forward-one-backward per tick.
+
+    Schedule (stage ``s``, microbatch ``m``):
+      forward at tick ``s + m``; last stage's loss/cotangent at
+      ``(S-1) + m`` (same tick as its forward); backward of stage ``s`` at
+      ``(S-1) + m + (S-1-s)``.
+
+    Contracts:
+      ``embed_fn(shared, batch_mb) -> x_mb`` (per microbatch, differentiable
+      in ``shared``); ``stage_fn(stage_params, x) -> x``;
+      ``head_fn(shared, y_mb, batch_mb) -> loss_mb`` — the MICROBATCH's
+      scalar loss; the engine reports (and differentiates) the mean over
+      microbatches. ``batch`` is a pytree of ``[local_batch, ...]`` arrays.
+      Embed/head compute runs under ``lax.cond`` so only the stages that own
+      it pay for it; ``shared`` params are replicated inside the body
+      (boundary all-gather per step — the storage stays sharded, e.g. the
+      pp-sharded embedding table).
+
+    Returns ``(loss, (dstacked, dshared))`` — plug straight into the
+    optimizer; not differentiated from outside.
+    """
+    S = mesh.shape[axis_name]
+    M = num_microbatches
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    x_spec = P(BATCH_AXES)
+    batch_specs = jax.tree.map(lambda _: x_spec, batch)
+    shared_specs = jax.tree.map(lambda _: P(), shared_params)
+
+    if S == 1:
+        def loss_fn(stacked, shared):
+            mb = jax.tree.map(
+                lambda t: t.reshape((M, t.shape[0] // M) + t.shape[1:]), batch
+            )
+            def body(acc, m):
+                bm = jax.tree.map(lambda t: t[m], mb)
+                y = sequential(stage_fn, stacked, embed_fn(shared, bm))
+                return acc + head_fn(shared, y, bm) / M, None
+            acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                  jnp.arange(M))
+            return acc
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            stacked_params, shared_params
+        )
+        return loss, grads
+
+    def local(stacked, shared, batch):
+        stage = jax.lax.axis_index(axis_name)
+        mb = jax.tree.map(
+            lambda t: t.reshape((M, t.shape[0] // M) + t.shape[1:]), batch
+        )
+        take = lambda m: jax.tree.map(lambda t: t[m], mb)  # noqa: E731
+        params_sq = jax.tree.map(lambda p: jnp.squeeze(p, 0), stacked)
+
+        # Shapes: probe one microbatch's activation abstractly.
+        x0_shape = jax.eval_shape(lambda: embed_fn(shared, take(0)))
+        zeros_x = jnp.zeros(x0_shape.shape, x0_shape.dtype)
+
+        depth = 2 * S  # > max input lifetime 2(S-1)+1, so slots never clash
+        carry0 = dict(
+            recv_fwd=zeros_x,
+            recv_bwd=jnp.zeros_like(zeros_x),
+            stash=jnp.zeros((depth,) + zeros_x.shape, zeros_x.dtype),
+            loss=jnp.zeros((), jnp.float32),
+            dstacked=jax.tree.map(jnp.zeros_like, params_sq),
+            dshared=jax.tree.map(jnp.zeros_like, shared),
+        )
+        perm_fwd = [(i, i + 1) for i in range(S - 1)]
+        perm_bwd = [(i + 1, i) for i in range(S - 1)]
+
+        def tick(c, t):
+            # ---- forward unit: stage s, microbatch mf = t - s ------------
+            mf = t - stage
+            valid_f = (mf >= 0) & (mf < M)
+            mf_i = jnp.clip(mf, 0, M - 1)
+            bm_f = take(mf_i)
+            x_embed = jax.lax.cond(
+                stage == 0,
+                lambda: embed_fn(shared, bm_f),
+                lambda: zeros_x,
+            )
+            x_in = jnp.where(stage == 0, x_embed, c["recv_fwd"])
+            y = stage_fn(params_sq, x_in)
+            # Single-slot masked write (NOT a whole-buffer select): keeps
+            # the scan carry's in-place dynamic-update-slice. Equivalent:
+            # an invalid tick's clipped index rewrites its slot with the
+            # slot's own value.
+            slot = mf_i % depth
+            stash = c["stash"].at[slot].set(
+                jnp.where(valid_f, x_in, c["stash"][slot])
+            )
+
+            # Last stage: loss + output cotangent for mf, THIS tick.
+            def head_vjp():
+                loss_m, vjp = jax.vjp(
+                    lambda sh, yy: head_fn(sh, yy, bm_f), shared, y
+                )
+                dsh, dy = vjp(jnp.ones((), loss_m.dtype) / M)
+                return loss_m, dsh, dy
+
+            def head_zero():
+                return (
+                    jnp.zeros((), jnp.float32),
+                    jax.tree.map(jnp.zeros_like, shared),
+                    jnp.zeros_like(y),
+                )
+
+            loss_m, dsh_head, g_y = jax.lax.cond(
+                stage == S - 1, head_vjp, head_zero
+            )
+            loss = c["loss"] + jnp.where(valid_f, loss_m, 0.0)
+            dshared = jax.tree.map(
+                lambda a, b: a + jnp.where(valid_f, b, jnp.zeros_like(b)),
+                c["dshared"], dsh_head,
+            )
+
+            # ---- backward unit: stage s, microbatch mb = t-2(S-1)+s ------
+            mb_idx = t - 2 * (S - 1) + stage
+            valid_b = (mb_idx >= 0) & (mb_idx < M)
+            mb_i = jnp.clip(mb_idx, 0, M - 1)
+            g_in = jnp.where(stage == S - 1, g_y, c["recv_bwd"])
+            x_b = stash[mb_i % depth]
+            _, svjp = jax.vjp(stage_fn, params_sq, x_b)
+            dp, dx = svjp(g_in)
+            dstacked = jax.tree.map(
+                lambda a, b: a + jnp.where(valid_b, b, jnp.zeros_like(b)),
+                c["dstacked"], dp,
+            )
+
+            # Stage 0: cotangent leaves the pipeline into the embed params.
+            bm_b = take(mb_i)
+
+            def embed_vjp():
+                _, evjp = jax.vjp(lambda sh: embed_fn(sh, bm_b), shared)
+                (dsh,) = evjp(dx)
+                return dsh
+
+            dsh_embed = jax.lax.cond(
+                stage == 0,
+                embed_vjp,
+                lambda: jax.tree.map(jnp.zeros_like, shared),
+            )
+            dshared = jax.tree.map(
+                lambda a, b: a + jnp.where(valid_b, b, jnp.zeros_like(b)),
+                dshared, dsh_embed,
+            )
+
+            recv_fwd = jax.lax.ppermute(y, axis_name, perm_fwd)
+            recv_bwd = jax.lax.ppermute(
+                jnp.where(valid_b, dx, jnp.zeros_like(dx)),
+                axis_name, perm_bwd,
+            )
+            return dict(
+                recv_fwd=recv_fwd, recv_bwd=recv_bwd, stash=stash,
+                loss=loss, dstacked=dstacked, dshared=dshared,
+            ), None
+
+        c, _ = jax.lax.scan(tick, carry0, jnp.arange(M + 2 * (S - 1)))
+        # Reductions. Over pp: loss lives on the last stage, embed-grads on
+        # stage 0, head-grads on the last stage — psum = combine + broadcast
+        # (everything else is 0). Over the batch axes: each dp/fsdp replica
+        # saw only its batch shard, so the global mean-loss gradient is the
+        # replica-mean — this psum is THE data-parallel gradient sync (the
+        # reference's NCCL all-reduce), emitted here explicitly because the
+        # engine owns differentiation instead of jax.grad.
+        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
+        nrep = 1
+        for a in batch_axes:
+            nrep *= mesh.shape[a]
+        loss = jax.lax.psum(c["loss"], (axis_name,) + batch_axes) / (M * nrep)
+        dshared = jax.tree.map(
+            lambda g: jax.lax.psum(g, (axis_name,) + batch_axes) / nrep,
+            c["dshared"],
+        )
+        dstacked = jax.tree.map(
+            lambda g: jnp.expand_dims(
+                jax.lax.psum(g, batch_axes) / nrep, 0
+            ),
+            c["dstacked"],
+        )
+        return loss, dstacked, dshared
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, shared_specs, batch_specs),
+        out_specs=(P(), param_specs, shared_specs),
+        check_vma=False,
+    )
+    loss, dstacked, dshared = fn(stacked_params, shared_params, batch)
+    return loss, (dstacked, dshared)
 
 
 def gpipe(

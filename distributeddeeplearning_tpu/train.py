@@ -405,7 +405,51 @@ class Trainer:
             check_vma=False,
         )(grads, opt_state, params)
 
+    def _make_pipeline_train_step(self):
+        """schedule='1f1b_interleaved': the pipeline engine computes loss AND
+        grads inside one schedule (parallel/pp.interleaved_1f1b), so the step
+        skips ``jax.value_and_grad`` entirely; the optimizer update is
+        unchanged (incl. the fused/ZeRO shard_map dispatch)."""
+        if self.grad_accum != 1:
+            raise NotImplementedError(
+                "grad_accum composes with schedule='gpipe'/'1f1b'; the "
+                "interleaved engine already microbatches internally"
+            )
+
+        def step_fn(state: TrainState, batch):
+            loss, grads = self.model.pipeline_value_and_grad(
+                state.params, batch, self.mesh
+            )
+            updates_tx, new_opt_state = self._tx_update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates_tx)
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+            )
+            return new_state, {"loss": loss}
+
+        donate = (0,) if self._donate else ()
+        return MeshedJit(
+            jax.jit(
+                step_fn,
+                in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
+                out_shardings=(self.state_shardings, None),
+                donate_argnums=donate,
+            ),
+            self.mesh,
+        )
+
     def _make_train_step(self):
+        # pipeline=False is the sequential parity-oracle mode — it must win
+        # over the schedule (the engine would pipeline over pp regardless).
+        if getattr(self.model, "schedule", None) == "1f1b_interleaved" and (
+            getattr(self.model, "pipeline", True)
+        ):
+            return self._make_pipeline_train_step()
+
         def step_fn(state: TrainState, batch):
             rng = fold_in_step(state.rng, state.step)
 

@@ -63,3 +63,28 @@ def test_single_device_mesh():
 
 def test_batch_axes_subset_of_mesh_axes():
     assert set(BATCH_AXES) <= set(MESH_AXES)
+
+
+def test_apply_xla_perf_flags_probes_acceptance(monkeypatch):
+    from distributeddeeplearning_tpu.mesh import apply_xla_perf_flags
+
+    # Accepted flags (generic, valid on every runtime) are applied on top of
+    # what's already there, idempotently.
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    good = ("--xla_cpu_enable_fast_math=false",)
+    first = apply_xla_perf_flags(good)
+    assert "--xla_force_host_platform_device_count=8" in first
+    assert good[0] in first
+    assert apply_xla_perf_flags(good) == first  # idempotent
+
+    # Rejected flags (XLA aborts on unknown names) must leave the
+    # environment untouched and warn, not crash the training process.
+    import os
+
+    import pytest
+
+    before = os.environ["XLA_FLAGS"]
+    with pytest.warns(RuntimeWarning, match="rejected"):
+        out = apply_xla_perf_flags(("--xla_no_such_flag_ever=true",))
+    assert out == before
+    assert os.environ["XLA_FLAGS"] == before

@@ -139,6 +139,134 @@ class TestOneFOneBMechanism:
         assert gpipe_bubble_fraction(1, 1) == 0.0
 
 
+class TestInterleaved1F1B:
+    """TRUE 1F1B (loss inside the schedule, grads out; stash bounded by
+    pipeline depth, not microbatch count)."""
+
+    S, M, micro, D, V = 4, 8, 2, 16, 32
+
+    def _problem(self, dp=2):
+        ks = jax.random.split(jax.random.PRNGKey(0), 5)
+        stacked = {
+            "w": jax.random.normal(ks[0], (self.S, self.D, self.D)) * 0.3,
+            "b": jnp.zeros((self.S, self.D)),
+        }
+        shared = {
+            "emb": jax.random.normal(ks[1], (self.V, self.D)) * 0.5,
+            "head": jax.random.normal(ks[2], (self.D, self.V)) * 0.5,
+        }
+        n = self.M * self.micro * dp
+        batch = {
+            "tokens": jax.random.randint(ks[3], (n, 4), 0, self.V),
+            "labels": jax.random.randint(ks[4], (n,), 0, self.V),
+        }
+
+        def embed_fn(sh, bm):
+            return sh["emb"][bm["tokens"]].mean(1)
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        def head_fn(sh, y, bm):
+            logits = y @ sh["head"]
+            return -jax.nn.log_softmax(logits)[
+                jnp.arange(y.shape[0]), bm["labels"]
+            ].mean()
+
+        return stacked, shared, batch, embed_fn, stage_fn, head_fn
+
+    def _oracle(self, stacked, shared, batch, embed_fn, stage_fn, head_fn):
+        def loss_fn(stacked, shared):
+            mb = {
+                k: v.reshape((self.M, -1) + v.shape[1:])
+                for k, v in batch.items()
+            }
+
+            def body(acc, m):
+                bm = {k: v[m] for k, v in mb.items()}
+                y = sequential(stage_fn, stacked, embed_fn(shared, bm))
+                return acc + head_fn(shared, y, bm) / self.M, None
+
+            acc, _ = jax.lax.scan(
+                body, jnp.zeros((), jnp.float32), jnp.arange(self.M)
+            )
+            return acc
+
+        return jax.value_and_grad(loss_fn, argnums=(0, 1))(stacked, shared)
+
+    def test_loss_and_grads_match_oracle(self, mesh_factory):
+        from distributeddeeplearning_tpu.parallel.pp import interleaved_1f1b
+
+        stacked, shared, batch, e, s, h = self._problem()
+        lo, go = self._oracle(stacked, shared, batch, e, s, h)
+        mesh = mesh_factory(dp=2, pp=self.S)
+        lp, gp = jax.jit(
+            lambda st, sh, b: interleaved_1f1b(
+                e, s, h, st, sh, b, mesh=mesh, num_microbatches=self.M
+            )
+        )(stacked, shared, batch)
+        np.testing.assert_allclose(float(lp), float(lo), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+            gp, go,
+        )
+
+    def test_trainer_end_to_end_parity(self, mesh1, mesh_factory):
+        ref = _train_losses(mesh1, pipeline=False)
+        inter = _train_losses(
+            mesh_factory(dp=2, pp=4), pipeline=True,
+            schedule="1f1b_interleaved",
+        )
+        np.testing.assert_allclose(ref, inter, rtol=2e-5)
+
+    def test_stash_bounded_by_pipeline_depth(self):
+        # The schedule's defining property: for M >> S the interleaved
+        # engine holds at most 2S microbatch activations; the custom_vjp
+        # 1F1B stashes all M stage inputs. Compare compiled temp memory at
+        # S=2, M=16.
+        from distributeddeeplearning_tpu.parallel.pp import interleaved_1f1b
+
+        from helpers import mesh_of
+
+        old = (self.S, self.M, self.D, self.V)
+        # Wide activations so the stash dominates the comparison (at tiny D
+        # the head/embed buffers the interleaved engine also holds would
+        # swamp the 2S-vs-M stash difference).
+        self.S, self.M, self.D, self.V = 2, 16, 2048, 8
+        try:
+            stacked, shared, batch, e, s, h = self._problem(dp=1)
+            mesh = mesh_of(pp=2)  # exactly 2 devices: no dp absorption
+
+            inter = (
+                jax.jit(
+                    lambda st, sh, b: interleaved_1f1b(
+                        e, s, h, st, sh, b, mesh=mesh, num_microbatches=16
+                    )
+                )
+                .lower(stacked, shared, batch)
+                .compile()
+                .memory_analysis()
+            )
+
+            x = e(shared, batch)
+
+            def vjp_loss(st, xx):
+                return (
+                    one_f_one_b(s, st, xx, mesh=mesh, num_microbatches=16)
+                    ** 2
+                ).sum()
+
+            vjp_pipe = (
+                jax.jit(jax.grad(vjp_loss, argnums=(0, 1)))
+                .lower(stacked, x)
+                .compile()
+                .memory_analysis()
+            )
+            assert inter.temp_size_in_bytes < vjp_pipe.temp_size_in_bytes
+        finally:
+            self.S, self.M, self.D, self.V = old
+
+
 def _train_losses(
     mesh, pipeline, steps=3, grad_accum=1, zero1=False, num_stages=4,
     schedule="gpipe",
