@@ -48,6 +48,9 @@ class SelfAttention(nn.Module):
     - ``ulysses``: same core, but q/k/v are constrained to the
                    seq-gathered/head-sharded layout so the partitioner emits
                    the Ulysses all-to-alls around it (``cp`` mesh axis);
+    - ``ulysses_flash``: Ulysses reshard around the fused Pallas flash
+                   kernel (sharded over heads on ``(tp, cp)`` inside);
+                   mask=None, dropout=0 only;
     - ``ring``:    explicit shard_map ring attention over ``cp`` with
                    ppermute KV rotation (``ops/ring_attention.py``); needs
                    ``mesh`` and supports mask=None, dropout=0 only;
@@ -115,7 +118,7 @@ class SelfAttention(nn.Module):
                 q, k, v, self.mesh, causal=self.causal
             )
         else:
-            if self.attn_impl == "ulysses":
+            if self.attn_impl in ("ulysses", "ulysses_flash"):
                 if self.mesh is not None:
                     from ..parallel.sp_ulysses import check_ulysses_shapes
 
@@ -132,23 +135,42 @@ class SelfAttention(nn.Module):
                 q, k, v = ulysses_reshard(q, k, v)
             elif self.attn_impl != "xla":
                 raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-            scores = scores / np.sqrt(self.head_dim)
-            if self.causal:
-                q_len, k_len = scores.shape[-2], scores.shape[-1]
-                causal_mask = jnp.tril(jnp.ones((q_len, k_len), bool))
-                scores = jnp.where(causal_mask[None, None], scores, -1e30)
-            if mask is not None:
-                # mask: [batch, k_len] (1 = attend) or broadcastable to scores.
-                if mask.ndim == 2:
-                    mask = mask[:, None, None, :]
-                scores = jnp.where(mask.astype(bool), scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-            probs = nn.Dropout(self.dropout_rate, deterministic=deterministic)(
-                probs
-            )
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-            if self.attn_impl == "ulysses":
+            if self.attn_impl == "ulysses_flash":
+                if mask is not None or (
+                    self.dropout_rate and not deterministic
+                ):
+                    raise NotImplementedError(
+                        "ulysses_flash supports mask=None and no active "
+                        "attention-dropout"
+                    )
+                from ..ops import flash_attention
+
+                # Interior layout: seq gathered, heads over (tp, cp).
+                out = flash_attention(
+                    q, k, v, causal=self.causal, head_axes=("tp", "cp")
+                )
+            else:
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, k
+                ).astype(jnp.float32)
+                scores = scores / np.sqrt(self.head_dim)
+                if self.causal:
+                    q_len, k_len = scores.shape[-2], scores.shape[-1]
+                    causal_mask = jnp.tril(jnp.ones((q_len, k_len), bool))
+                    scores = jnp.where(
+                        causal_mask[None, None], scores, -1e30
+                    )
+                if mask is not None:
+                    # mask: [batch, k_len] (1 = attend) or broadcastable.
+                    if mask.ndim == 2:
+                        mask = mask[:, None, None, :]
+                    scores = jnp.where(mask.astype(bool), scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+                probs = nn.Dropout(
+                    self.dropout_rate, deterministic=deterministic
+                )(probs)
+                out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            if self.attn_impl in ("ulysses", "ulysses_flash"):
                 from ..parallel.sp_ulysses import ulysses_restore
 
                 out = ulysses_restore(out)

@@ -364,6 +364,7 @@ def flash_attention(
     block_k: int = 128,
     interpret: bool | None = None,
     mesh=None,
+    head_axes: tuple[str, ...] = ("tp",),
 ):
     """Fused attention over ``[batch, seq, heads, head_dim]`` inputs.
 
@@ -374,10 +375,12 @@ def flash_attention(
     Sharding: a ``pallas_call`` is an opaque custom call the SPMD partitioner
     would replicate around, so under a mesh (passed explicitly or ambient via
     ``sharding.activation_mesh`` — the Trainer's steps install one) the kernel
-    runs inside ``shard_map`` over batch ('dp','fsdp') and heads ('tp') —
-    attention is independent per (batch, head), so each shard's kernel is the
-    whole computation for its slice. Sequence stays unsharded (ring attention
-    covers cp>1).
+    runs inside ``shard_map`` over batch ('dp','fsdp') and heads
+    (``head_axes``, default ('tp',); Ulysses passes ('tp','cp') for its
+    seq-gathered/head-sharded interior layout) — attention is independent per
+    (batch, head), so each shard's kernel is the whole computation for its
+    slice. Sequence stays unsharded inside the kernel (ring attention covers
+    seq-sharded execution).
     """
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
@@ -420,15 +423,18 @@ def flash_attention(
         mesh = _MESH_CTX.get()
     if mesh is not None:
         batch_ways = math.prod(mesh.shape[a] for a in BATCH_AXES)
-        tp = mesh.shape["tp"]
-        if batch_ways * tp > 1:
+        head_ways = math.prod(mesh.shape[a] for a in head_axes)
+        if batch_ways * head_ways > 1:
             if b % batch_ways:
                 raise ValueError(
                     f"flash: batch={b} not divisible by dp*fsdp={batch_ways}"
                 )
-            if h % tp:
-                raise ValueError(f"flash: heads={h} not divisible by tp={tp}")
-            spec = P(BATCH_AXES, None, "tp", None)
+            if h % head_ways:
+                raise ValueError(
+                    f"flash: heads={h} not divisible by "
+                    f"{'*'.join(head_axes)}={head_ways}"
+                )
+            spec = P(BATCH_AXES, None, head_axes, None)
             # check_vma=False: same jax-0.9.0 pallas-in-shard_map typing
             # limitation as ring_attention_pallas.py — no collectives exist
             # in the body, each shard is independent.

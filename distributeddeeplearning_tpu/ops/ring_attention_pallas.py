@@ -23,11 +23,14 @@ skipped at the JAX level with ``lax.cond`` (no kernel launch, no MXU work);
 the diagonal block applies the local causal mask inside the kernel (mode
 scalar in SMEM, since the visiting block id is a traced value).
 
-Backward: ``jax.custom_vjp`` whose bwd recomputes through the shard_map
-reference implementation — the designated correctness oracle — so training
-gradients are exactly the oracle's while the forward takes the fused path.
-A fused two-kernel ring backward (dq forward rotation, dk/dv reverse
-rotation) is the known next step.
+Backward: fused as well. The forward saves the per-row LSE; the backward
+makes one more lap of the ring rotating ``(k, v, dk, dv)`` together — each
+visit recomputes the visiting block's probabilities from (q, k, lse) and
+runs TWO kernels (flash-style): a dq kernel (kv-innermost grid, dq carried
+in VMEM scratch) and a dk/dv kernel (q-innermost grid, accumulators seeded
+from the rotating dk/dv and flushed back into them). After a full lap the
+accumulators arrive back at their home device. Hidden blocks skip both
+kernels at the JAX level (``lax.cond``), exactly like the forward.
 """
 
 from __future__ import annotations
@@ -44,7 +47,6 @@ from jax.sharding import PartitionSpec as P
 
 from ..mesh import BATCH_AXES
 from .flash_attention import _blk, _default_interpret
-from .ring_attention import _ring_attention_local
 
 _NEG_INF = -1e30
 _LANES = 128
@@ -190,41 +192,250 @@ def _ring_local_pallas_fwd_impl(
     m, l, acc = update(m, l, acc, kt, vt, cp - 1)
 
     out = acc / jnp.maximum(l, 1e-30)  # [bh, lq, d]
-    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))  # [bh, lq, 1]
+    return (
+        out.reshape(b, h, lq, d).transpose(0, 2, 1, 3).astype(q.dtype),
+        lse,
+    )
+
+
+# ---------------------------------------------------------------------------
+# fused backward: one more ring lap rotating (k, v, dk, dv)
+# ---------------------------------------------------------------------------
+
+
+def _ring_recompute_p_ds(
+    mode_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    qi, ki, bq, bk, sm_scale,
+):
+    """(p, ds, do) for one (q-block, kv-block) tile — the shared
+    probability/score-cotangent recompute both backward kernels consume
+    (mode-scalar analogue of ``flash_attention._recompute_p``; keeping it in
+    one place keeps dq and dk/dv bit-consistent)."""
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    s = jnp.where((mode_ref[0, 0] == 0) | (row >= col), s, _NEG_INF)
+    p = jnp.exp(s - lse_ref[0])  # (bq, bk)
+    do = do_ref[0].astype(jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0])
+    return p, ds, do
+
+
+def _ring_dq_kernel(
+    mode_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_in,
+    dq_out, dq_scr,
+    *, sm_scale, block_q, block_k, num_kv,
+):
+    """dq contribution of ONE visiting KV block, accumulated onto the carried
+    dq. Grid (bh, q_blocks, kv_blocks); kv innermost, dq in VMEM scratch."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _seed():
+        dq_scr[:] = dq_in[0]
+
+    _, ds, _ = _ring_recompute_p_ds(
+        mode_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+        qi, ki, block_q, block_k, sm_scale,
+    )
+    dq_scr[:] += sm_scale * jnp.dot(
+        ds, k_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ki == num_kv - 1)
+    def _flush():
+        dq_out[0] = dq_scr[:]
+
+
+def _ring_dkv_kernel(
+    mode_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_in, dv_in, dk_out, dv_out, dk_scr, dv_scr,
+    *, sm_scale, block_q, block_k, num_q,
+):
+    """dk/dv contribution of this device's queries to the visiting block,
+    accumulated onto the ROTATING dk/dv. Grid (bh, kv_blocks, q_blocks)."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _seed():
+        dk_scr[:] = dk_in[0]
+        dv_scr[:] = dv_in[0]
+
+    p, ds, do = _ring_recompute_p_ds(
+        mode_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+        qi, ki, block_q, block_k, sm_scale,
+    )
+    dv_scr[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dk_scr[:] += sm_scale * jax.lax.dot_general(
+        ds, q_ref[0].astype(jnp.float32),
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(qi == num_q - 1)
+    def _flush():
+        dk_out[0] = dk_scr[:]
+        dv_out[0] = dv_scr[:]
+
+
+def _ring_bwd_step(
+    q, kt, vt, do, lse, delta, dq, dkt, dvt, mode,
+    *, sm_scale, block_q, block_k, interpret,
+):
+    """One visiting block folded into (dq, dk_t, dv_t). All [bh, l, d] (q-
+    or k-sided); lse/delta [bh, lq, 1]."""
+    bh, lq, d = q.shape
+    lk = kt.shape[1]
+    bq = _blk(lq, block_q, "ring bwd q")
+    bk = _blk(lk, block_k, "ring bwd k")
+    num_q, num_kv = lq // bq, lk // bk
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    c_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    dq = pl.pallas_call(
+        functools.partial(
+            _ring_dq_kernel, sm_scale=sm_scale,
+            block_q=bq, block_k=bk, num_kv=num_kv,
+        ),
+        grid=(bh, num_q, num_kv),
+        in_specs=[smem, q_spec, k_spec, k_spec, q_spec, c_spec, c_spec,
+                  q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(mode, q, kt, vt, do, lse, delta, dq)
+
+    # kv-sided views of the q-sided blocks.
+    q_spec_k = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0))
+    k_spec_k = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
+    c_spec_k = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0))
+    dkt, dvt = pl.pallas_call(
+        functools.partial(
+            _ring_dkv_kernel, sm_scale=sm_scale,
+            block_q=bq, block_k=bk, num_q=num_q,
+        ),
+        grid=(bh, num_kv, num_q),
+        in_specs=[smem, q_spec_k, k_spec_k, k_spec_k, q_spec_k, c_spec_k,
+                  c_spec_k, k_spec_k, k_spec_k],
+        out_specs=[k_spec_k, k_spec_k],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(mode, q, kt, vt, do, lse, delta, dkt, dvt)
+    return dq, dkt, dvt
+
+
+def _ring_local_pallas_bwd_impl(
+    q, k, v, out, lse, g, *, axis_name, causal, block_q, block_k, interpret
+):
+    cp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, lq, d)  # noqa: E731
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    do = fold(g).astype(jnp.float32)
+    of = fold(out).astype(jnp.float32)
+    delta = jnp.sum(do * of, axis=-1, keepdims=True)  # [bh, lq, 1]
+
+    dq0 = jnp.zeros_like(qf, jnp.float32)
+    dk0 = jnp.zeros_like(kf, jnp.float32)
+    dv0 = jnp.zeros_like(vf, jnp.float32)
+
+    def update(dq, kt, vt, dkt, dvt, t):
+        src = (idx + t) % cp
+        mode = jnp.where(src == idx, jnp.int32(1), jnp.int32(0)).reshape(1, 1)
+        step = functools.partial(
+            _ring_bwd_step, sm_scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        if not causal:
+            return step(
+                qf, kt, vt, do, lse, delta, dq, dkt, dvt,
+                jnp.zeros((1, 1), jnp.int32),
+            )
+        return jax.lax.cond(
+            src <= idx,
+            lambda args: step(*args),
+            lambda args: (args[6], args[7], args[8]),
+            (qf, kt, vt, do, lse, delta, dq, dkt, dvt, mode),
+        )
+
+    perm = [(i, (i - 1) % cp) for i in range(cp)]
+
+    def scan_step(carry, t):
+        dq, kt, vt, dkt, dvt = carry
+        dq, dkt, dvt = update(dq, kt, vt, dkt, dvt, t)
+        # Rotate KV *and its gradient accumulators* together.
+        kt = jax.lax.ppermute(kt, axis_name, perm)
+        vt = jax.lax.ppermute(vt, axis_name, perm)
+        dkt = jax.lax.ppermute(dkt, axis_name, perm)
+        dvt = jax.lax.ppermute(dvt, axis_name, perm)
+        return (dq, kt, vt, dkt, dvt), None
+
+    # Peel the final step (mirroring the forward): after it, only the
+    # ACCUMULATORS need one last hop home — the kt/vt ppermutes of a full
+    # cp-lap would be dead comms.
+    (dq, kt, vt, dk, dv), _ = jax.lax.scan(
+        scan_step, (dq0, kf, vf, dk0, dv0), jnp.arange(cp - 1)
+    )
+    dq, dk, dv = update(dq, kt, vt, dk, dv, cp - 1)
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    unfold = lambda t, dt: (  # noqa: E731
+        t.reshape(b, h, lq, d).transpose(0, 2, 1, 3).astype(dt)
+    )
+    return unfold(dq, q.dtype), unfold(dk, k.dtype), unfold(dv, v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _ring_local_pallas(q, k, v, axis_name, causal, block_q, block_k, interpret):
-    return _ring_local_pallas_fwd_impl(
+    out, _ = _ring_local_pallas_fwd_impl(
         q, k, v, axis_name=axis_name, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
+    return out
 
 
 def _ring_local_pallas_fwd(
     q, k, v, axis_name, causal, block_q, block_k, interpret
 ):
-    out = _ring_local_pallas_fwd_impl(
+    out, lse = _ring_local_pallas_fwd_impl(
         q, k, v, axis_name=axis_name, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _ring_local_pallas_bwd(
     axis_name, causal, block_q, block_k, interpret, res, g
 ):
-    # Gradients via the shard_map reference implementation — the oracle —
-    # recomputed from the saved inputs (flash-style: activations are cheaper
-    # to recompute than to store).
-    q, k, v = res
-    _, vjp = jax.vjp(
-        functools.partial(
-            _ring_attention_local, axis_name=axis_name, causal=causal
-        ),
-        q, k, v,
+    q, k, v, out, lse = res
+    return _ring_local_pallas_bwd_impl(
+        q, k, v, out, lse, g,
+        axis_name=axis_name, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
 
 
 _ring_local_pallas.defvjp(_ring_local_pallas_fwd, _ring_local_pallas_bwd)

@@ -111,18 +111,41 @@ def test_ring_pallas_forward_matches_oracle_causal_and_full():
 
 
 def test_ring_pallas_gradients_match_oracle():
+    # Both causal modes: the fused backward has distinct code paths (the
+    # non-causal branch skips the lax.cond hidden-block gating).
     q, k, v = make_qkv()
     mesh = mesh_of(cp=4)
+    for causal in (True, False):
+        def loss_pallas(q, k, v):
+            return (
+                ring_attention_pallas(q, k, v, mesh, causal=causal) ** 2
+            ).sum()
 
-    def loss_pallas(q, k, v):
-        return (ring_attention_pallas(q, k, v, mesh, causal=True) ** 2).sum()
+        def loss_oracle(q, k, v):
+            return (ring_attention(q, k, v, mesh, causal=causal) ** 2).sum()
 
-    def loss_oracle(q, k, v):
-        return (ring_attention(q, k, v, mesh, causal=True) ** 2).sum()
+        gp = jax.jit(jax.grad(loss_pallas, argnums=(0, 1, 2)))(q, k, v)
+        go = jax.jit(jax.grad(loss_oracle, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(gp, go):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
 
-    gp = jax.jit(jax.grad(loss_pallas, argnums=(0, 1, 2)))(q, k, v)
-    go = jax.jit(jax.grad(loss_oracle, argnums=(0, 1, 2)))(q, k, v)
-    for a, b in zip(gp, go):
+
+def test_ring_pallas_fused_bwd_composed_mesh():
+    # The backward is fused too (its own ring lap rotating (k, v, dk, dv));
+    # gradients must survive a composed dp×tp×cp mesh.
+    q, k, v = make_qkv(b=4, l=16, h=4, d=8)
+    mesh = mesh_of(dp=2, tp=2, cp=2)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v, mesh, causal=True) ** 2).sum()
+
+    gp = jax.jit(jax.grad(loss(ring_attention_pallas), argnums=(0, 1, 2)))(
+        q, k, v
+    )
+    gr = jax.jit(jax.grad(loss(ring_attention), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gp, gr):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
         )
@@ -164,6 +187,14 @@ def test_gpt2_ring_pallas_cp4_parity():
     l1 = run_gpt2(single_device_mesh())
     lp = run_gpt2(mesh_of(cp=4), attn_impl="ring_pallas")
     np.testing.assert_allclose(l1, lp, rtol=RTOL, atol=ATOL)
+
+
+def test_gpt2_ulysses_flash_cp4_parity():
+    # Ulysses reshard around the fused Pallas flash core (heads sharded over
+    # (tp, cp) inside the kernel's shard_map).
+    l1 = run_gpt2(single_device_mesh())
+    lu = run_gpt2(mesh_of(dp=2, cp=4), attn_impl="ulysses_flash")
+    np.testing.assert_allclose(l1, lu, rtol=RTOL, atol=ATOL)
 
 
 def test_gpt2_ring_composed_dp2_cp2_parity():
