@@ -92,7 +92,9 @@ def lm_task() -> Task:
     def loss_fn(logits, batch):
         targets = batch["tokens"][:, 1:]
         loss = _xent(logits, targets).mean()
-        return loss, {"loss": loss}
+        # exp(mean xent) — the LM eval metric; computed on-device, so the
+        # eval loop's batch-mean of it is the standard per-batch-ppl mean.
+        return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
 
     return Task(input_fn=input_fn, loss_fn=loss_fn)
 
@@ -567,6 +569,8 @@ def evaluate(trainer: Trainer, state: TrainState, batches) -> dict[str, float]:
     batch-mean of every metric. The vision tasks report top-1 ``accuracy``
     here — the parity half of the north-star metric (``BASELINE.json:2``:
     "top-1 parity at 90 epochs")."""
+    import math
+
     sums: dict[str, float] = {}
     count = 0
     for batch in batches:
@@ -576,7 +580,12 @@ def evaluate(trainer: Trainer, state: TrainState, batches) -> dict[str, float]:
         count += 1
     if count == 0:
         raise ValueError("evaluate() got an empty batch iterable")
-    return {f"eval_{k}": v / count for k, v in sums.items()}
+    out = {f"eval_{k}": v / count for k, v in sums.items()}
+    if "perplexity" in sums and "loss" in sums:
+        # The standard eval number is exp(mean loss); a mean of per-batch
+        # exp(loss) would overstate it (Jensen) and drift with batch count.
+        out["eval_perplexity"] = math.exp(out["eval_loss"])
+    return out
 
 
 def fit(

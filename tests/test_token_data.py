@@ -178,3 +178,33 @@ def test_grain_per_host_loader_state_roundtrip(token_file):
     it2.set_state(saved)
     np.testing.assert_array_equal(next(it2), fourth)
     assert first_three[0].shape == (4, 33)
+
+
+def test_grain_per_host_loader_with_worker_processes(token_file):
+    # num_workers>0 pickles the source into each worker process; the source
+    # must ship its PATH and re-open the memmap per process (shipping the
+    # memmap itself would materialize the whole corpus in every worker's
+    # RAM). Grain's batch order differs BETWEEN worker counts, so the
+    # contract is: workers run at all (the pickling path), and the stream is
+    # deterministic at a fixed worker count.
+    a = iter(grain_per_host_loader(token_file, batch_size=4, seq_len=32,
+                                   seed=1, num_workers=2))
+    b = iter(grain_per_host_loader(token_file, batch_size=4, seq_len=32,
+                                   seed=1, num_workers=2))
+    for _ in range(3):
+        xa, xb = np.asarray(next(a)), np.asarray(next(b))
+        assert xa.shape == (4, 33)
+        np.testing.assert_array_equal(xa, xb)
+
+
+def test_grain_source_pickles_without_tokens():
+    import pickle
+
+    from distributeddeeplearning_tpu.data_text import _GrainSeqSource
+
+    src = _GrainSeqSource("/nonexistent/x.tok", 32, 7)
+    blob = pickle.dumps(src)
+    clone = pickle.loads(blob)
+    assert clone._path == "/nonexistent/x.tok"
+    assert clone._tokens is None  # memmap never travels through the pickle
+    assert len(clone) == 7
