@@ -30,8 +30,9 @@ class GPT2(nn.Module):
     dropout_rate: float = 0.0
     remat: str = "none"
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"  # xla | ulysses | ring (see models/transformer.py)
-    mesh: object = None  # required for attn_impl='ring'
+    attn_impl: str = "xla"  # xla | ulysses | ulysses_flash | ring |
+    # ring_pallas | flash (see models/transformer.py)
+    mesh: object = None  # required for the ring attn_impl variants
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):

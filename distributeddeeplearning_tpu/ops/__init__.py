@@ -1,9 +1,13 @@
-"""Kernel/op layer: attention implementations and (later) Pallas kernels.
+"""Kernel/op layer — the reference's "CUDA forward/backward kernels"
+(``BASELINE.json:5``) map here.
 
-The reference's "CUDA forward/backward kernels" (``BASELINE.json:5``) map here:
-the default implementation is XLA-fused HLO (jit + autodiff); long-context
-variants (ring attention) are explicit shard_map programs; Pallas Mosaic
-kernels provide fused alternatives for the hot ops on real TPU.
+The default implementations are XLA-fused HLO (jit + autodiff); the Pallas
+Mosaic kernels provide fused alternatives for the hot ops: flash attention
+(fwd + two-kernel bwd, shard_map'd over batch/head axes), ring attention
+(fwd AND bwd fused, KV + gradient accumulators rotating over the cp ring),
+and the fused AdamW update (whole-tree single launch, shard-local under the
+Trainer's optimizer-state specs). Every kernel keeps a pure-XLA fallback and
+interpret-mode tests.
 """
 
 from .flash_attention import attention_reference, flash_attention  # noqa: F401
