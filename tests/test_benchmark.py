@@ -1,0 +1,68 @@
+"""Benchmark harness as test (SURVEY §4 tier 6): the measurement machinery
+itself is CI-checked — throughput is positive, the no-recompilation guard
+holds, the record carries the driver-contract fields, and ``vs_baseline``
+is honest about missing baselines (``None``, never a flattering 1.0).
+"""
+
+import json
+
+import pytest
+
+from distributeddeeplearning_tpu.benchmark import run_benchmark, vs_baseline
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+
+
+def _tiny_cfg():
+    return Config(
+        model=ModelConfig(name="resnet18", kwargs={"num_classes": 10}),
+        data=DataConfig(
+            kind="synthetic_image", batch_size=16, image_size=8,
+            n_distinct=2,
+        ),
+        optim=OptimConfig(name="sgd", lr=0.1),
+        train=TrainConfig(task="classification", log_every=0),
+        mesh=MeshConfig(dp=-1),
+    )
+
+
+def test_run_benchmark_record_contract():
+    record = run_benchmark(_tiny_cfg(), warmup=2, steps=3)
+    assert record["value"] > 0
+    assert record["steps_per_sec"] > 0
+    assert record["unit"] == "images/sec/chip"
+    assert record["device_count"] >= 1
+    assert record["platform"] == "cpu"  # the pytest harness is CPU-pinned
+    assert record["params"] > 1e6
+    # The record must be JSON-serializable as-is (driver contract: one line).
+    json.dumps(record)
+
+
+def test_run_benchmark_zero_warmup_is_legal():
+    record = run_benchmark(_tiny_cfg(), warmup=0, steps=2)
+    assert record["value"] > 0
+
+
+def test_vs_baseline_unknown_metric_is_null(tmp_path):
+    # Round-2 regression: an absent baseline reported 1.0, making a
+    # chip-down CPU fallback read as "on par".
+    assert vs_baseline("no_such_metric", 123.0, repo_root=str(tmp_path)) is None
+
+
+def test_vs_baseline_known_metric_ratio(tmp_path):
+    (tmp_path / "BENCH_BASELINE.json").write_text('{"m": 50.0}\n')
+    assert vs_baseline("m", 100.0, repo_root=str(tmp_path)) == pytest.approx(2.0)
+
+
+def test_vs_baseline_record_establishes_baseline(tmp_path):
+    assert vs_baseline("m2", 40.0, repo_root=str(tmp_path), record=True) == 1.0
+    table = json.loads((tmp_path / "BENCH_BASELINE.json").read_text())
+    assert table["m2"] == 40.0
+    # and is read back on the next call
+    assert vs_baseline("m2", 80.0, repo_root=str(tmp_path)) == pytest.approx(2.0)

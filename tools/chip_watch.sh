@@ -1,0 +1,19 @@
+#!/bin/bash
+# The attached TPU intermittently wedges at backend init (see BASELINE.md's
+# chip-health log). This watcher probes every 10 minutes and, on recovery,
+# runs tools/measure_tpu.py once to populate TPU_NUMBERS.json with the
+# per-config real-chip measurements BASELINE.md's table is waiting on.
+#
+#   nohup tools/chip_watch.sh > /tmp/chip_watch.log 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+for i in $(seq 1 30); do
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "chip alive — measuring"
+    timeout 2400 python tools/measure_tpu.py
+    exit 0
+  fi
+  echo "probe $i: wedged"
+  sleep 600
+done
+echo "gave up after 30 probes"
+exit 1

@@ -1,0 +1,51 @@
+"""Real-TPU numbers for BASELINE.md: run every workload config through
+``benchmark.run_benchmark`` on the attached chip and write TPU_NUMBERS.json
+at the repo root. Run directly (chip must be healthy) or via
+``tools/chip_watch.sh``, which probes the intermittently-wedging chip and
+fires this on recovery."""
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from distributeddeeplearning_tpu.benchmark import run_benchmark  # noqa: E402
+from distributeddeeplearning_tpu.config import (  # noqa: E402
+    apply_overrides,
+    load_config,
+)
+
+# (config, overrides, warmup, timed steps)
+RUNS = [
+    ("resnet18_cifar10", [], 5, 30),
+    ("resnet50_imagenet", [], 5, 20),
+    ("bert_mlm", [], 5, 20),
+    ("gpt2_owt", [], 3, 10),
+    ("vit_imagenet21k", [], 3, 10),
+]
+
+
+def main() -> int:
+    out = {}
+    for name, overrides, warmup, steps in RUNS:
+        try:
+            cfg = apply_overrides(
+                load_config(os.path.join(_REPO, "configs", f"{name}.py")),
+                overrides,
+            )
+            record = run_benchmark(cfg, warmup=warmup, steps=steps)
+            out[name] = record
+            print("RESULT", name, json.dumps(record), flush=True)
+        except Exception as e:  # keep measuring the rest
+            out[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
+            print("RESULT", name, "FAILED", out[name]["error"], flush=True)
+    with open(os.path.join(_REPO, "TPU_NUMBERS.json"), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
