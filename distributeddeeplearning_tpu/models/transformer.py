@@ -58,7 +58,10 @@ class SelfAttention(nn.Module):
                    Pallas kernel (``ops/ring_attention_pallas.py``); same
                    constraints as ``ring``;
     - ``flash``:   fused Pallas flash-attention kernel
-                   (``ops/flash_attention.py``); mask=None, dropout=0 only.
+                   (``ops/flash_attention.py``); supports mask=None or a
+                   [batch, k_len] contiguous-prefix key-padding mask
+                   (non-prefix masks poison the output to NaN); no active
+                   attention-dropout.
     """
 
     num_heads: int
@@ -94,14 +97,35 @@ class SelfAttention(nn.Module):
         v = proj("value")(x)
 
         if self.attn_impl == "flash":
-            if mask is not None or (self.dropout_rate and not deterministic):
+            if self.dropout_rate and not deterministic:
                 raise NotImplementedError(
-                    "flash attention supports mask=None and no active "
-                    "attention-dropout"
+                    "flash attention supports no active attention-dropout"
                 )
+            kv_valid = None
+            not_prefix = None
+            if mask is not None:
+                if mask.ndim != 2:
+                    raise NotImplementedError(
+                        "flash attention supports key-padding masks "
+                        "([batch, k_len] contiguous prefix) or mask=None"
+                    )
+                # Contiguous-prefix padding mask -> per-sequence kv limit.
+                # Whether a mask IS a prefix is data-dependent, so it cannot
+                # raise under jit — instead non-prefix rows are poisoned to
+                # NaN below: loud (debug_nans / NaN loss) rather than
+                # silently attending to the wrong columns.
+                kv_valid = mask.astype(jnp.int32).sum(-1)
+                prefix = jnp.arange(mask.shape[-1])[None, :] < kv_valid[:, None]
+                not_prefix = (mask.astype(bool) != prefix).any(-1)
             from ..ops import flash_attention
 
-            out = flash_attention(q, k, v, causal=self.causal)
+            out = flash_attention(
+                q, k, v, causal=self.causal, kv_valid_lens=kv_valid
+            )
+            if not_prefix is not None:
+                out = jnp.where(
+                    not_prefix[:, None, None, None], jnp.nan, out
+                )
         elif self.attn_impl in ("ring", "ring_pallas"):
             if mask is not None or (self.dropout_rate and not deterministic):
                 raise NotImplementedError(

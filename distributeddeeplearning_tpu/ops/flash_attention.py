@@ -57,9 +57,18 @@ def _blk(seq: int, requested: int, name: str) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _col_mask(s, ki, block_q, block_k, limit):
+    """Mask scores whose GLOBAL kv column index >= limit (static or traced)."""
+    col = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(col < limit, s, _NEG_INF)
+
+
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, sm_scale, causal, block_q, block_k, num_kv, valid_len=None,
+    use_vl=False,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -97,10 +106,11 @@ def _fwd_kernel(
             # not contribute. Padded q rows produce garbage rows the wrapper
             # slices away. Under causal the diagonal mask already excludes
             # every padded column for valid rows.
-            col = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(col < valid_len, s, _NEG_INF)
+            s = _col_mask(s, ki, block_q, block_k, valid_len)
+        if use_vl:
+            # Per-sequence key-padding limit (runtime, SMEM): columns at or
+            # beyond this batch element's valid length never contribute.
+            s = _col_mask(s, ki, block_q, block_k, vl_ref[pl.program_id(0)])
         m_prev = m_scr[:, :1]  # (bq, 1)
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -121,9 +131,10 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-         valid_len=None):
-    """q/k/v: [bh, seq, d] -> (o [bh, seq, d], lse [bh, seq] fp32)."""
+def _fwd(q, k, v, vl, causal, sm_scale, block_q, block_k, interpret,
+         valid_len=None, use_vl=False):
+    """q/k/v: [bh, seq, d]; vl: [bh] int32 per-row kv limits (used when
+    ``use_vl``) -> (o [bh, seq, d], lse [bh, seq] fp32)."""
     bh, seq, d = q.shape
     block_q = _blk(seq, block_q, "flash fwd q")
     block_k = _blk(seq, block_k, "flash fwd k")
@@ -133,12 +144,13 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv=num_kv,
-        valid_len=valid_len,
+        valid_len=valid_len, use_vl=use_vl,
     )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # vl
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -160,7 +172,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(vl, q, k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -169,7 +181,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
 
 
 def _recompute_p(
-    q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki, bq, bk, valid_len=None
+    q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki, bq, bk, valid_len=None,
+    vl_ref=None, use_vl=False,
 ):
     """exp(scale*QK^T - lse) for one (q-block, kv-block) tile, fp32."""
     q = q_ref[0].astype(jnp.float32) * sm_scale
@@ -182,8 +195,9 @@ def _recompute_p(
         col = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(row >= col, s, _NEG_INF)
     elif valid_len is not None:
-        col = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(col < valid_len, s, _NEG_INF)
+        s = _col_mask(s, ki, bq, bk, valid_len)
+    if use_vl:
+        s = _col_mask(s, ki, bq, bk, vl_ref[pl.program_id(0)])
     return jnp.exp(s - lse_ref[0])  # lse block is (bq, 1); masked -> 0
 
 
@@ -196,8 +210,10 @@ def _delta(o_ref, do_ref):
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr, delta_scr,
+    vl_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+    dq_scr, delta_scr,
     *, sm_scale, causal, block_q, block_k, num_kv, valid_len=None,
+    use_vl=False,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -216,7 +232,7 @@ def _dq_kernel(
     def _block():
         p = _recompute_p(
             q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki,
-            block_q, block_k, valid_len,
+            block_q, block_k, valid_len, vl_ref, use_vl,
         )
         do = do_ref[0].astype(jnp.float32)  # (bq, d)
         dp = jax.lax.dot_general(
@@ -235,9 +251,10 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+    vl_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
     *, sm_scale, causal, block_q, block_k, num_q, valid_len=None,
+    use_vl=False,
 ):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -255,7 +272,7 @@ def _dkv_kernel(
     def _block():
         p = _recompute_p(
             q_ref, k_ref, lse_ref, sm_scale, causal, qi, ki,
-            block_q, block_k, valid_len,
+            block_q, block_k, valid_len, vl_ref, use_vl,
         )  # (bq, bk)
         do = do_ref[0].astype(jnp.float32)  # (bq, d)
         dv_scr[:] += jax.lax.dot_general(
@@ -278,12 +295,14 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, interpret, valid_len, res, do):
-    q, k, v, o, lse = res
+def _bwd(causal, sm_scale, block_q, block_k, interpret, valid_len, use_vl,
+         res, do):
+    q, k, v, vl, o, lse = res
     bh, seq, d = q.shape
     block_q = _blk(seq, block_q, "flash bwd q")
     block_k = _blk(seq, block_k, "flash bwd k")
     num_q, num_kv = seq // block_q, seq // block_k
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     k_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
@@ -292,10 +311,10 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, valid_len, res, do):
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kv=num_kv,
-            valid_len=valid_len,
+            valid_len=valid_len, use_vl=use_vl,
         ),
         grid=(bh, num_q, num_kv),
-        in_specs=[q_spec_q, k_spec_q, k_spec_q, q_spec_q, q_spec_q,
+        in_specs=[smem, q_spec_q, k_spec_q, k_spec_q, q_spec_q, q_spec_q,
                   lse_spec_q],
         out_specs=q_spec_q,
         out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
@@ -304,7 +323,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, valid_len, res, do):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(vl, q, k, v, o, do, lse)
 
     # dK/dV: kv blocks outer, q blocks inner.
     q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
@@ -314,10 +333,10 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, valid_len, res, do):
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q=num_q,
-            valid_len=valid_len,
+            valid_len=valid_len, use_vl=use_vl,
         ),
         grid=(bh, num_kv, num_q),
-        in_specs=[q_spec_k, k_spec_k, k_spec_k, q_spec_k, q_spec_k,
+        in_specs=[smem, q_spec_k, k_spec_k, k_spec_k, q_spec_k, q_spec_k,
                   lse_spec_k],
         out_specs=[k_spec_k, k_spec_k],
         out_shape=[
@@ -329,23 +348,24 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, valid_len, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
-    return dq, dk, dv
+    )(vl, q, k, v, o, do, lse)
+    # vl is an integer input: no cotangent.
+    return dq, dk, dv, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-           valid_len=None):
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-                valid_len)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, vl, causal, sm_scale, block_q, block_k, interpret,
+           valid_len=None, use_vl=False):
+    o, _ = _fwd(q, k, v, vl, causal, sm_scale, block_q, block_k, interpret,
+                valid_len, use_vl)
     return o
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-               valid_len):
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-                  valid_len)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, vl, causal, sm_scale, block_q, block_k, interpret,
+               valid_len, use_vl):
+    o, lse = _fwd(q, k, v, vl, causal, sm_scale, block_q, block_k, interpret,
+                  valid_len, use_vl)
+    return o, (q, k, v, vl, o, lse)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
@@ -365,6 +385,7 @@ def flash_attention(
     interpret: bool | None = None,
     mesh=None,
     head_axes: tuple[str, ...] = ("tp",),
+    kv_valid_lens=None,
 ):
     """Fused attention over ``[batch, seq, heads, head_dim]`` inputs.
 
@@ -381,6 +402,13 @@ def flash_attention(
     (batch, head), so each shard's kernel is the whole computation for its
     slice. Sequence stays unsharded inside the kernel (ring attention covers
     seq-sharded execution).
+
+    ``kv_valid_lens`` ([batch] int32): per-sequence key-padding limit —
+    columns at or beyond a sequence's valid length never contribute
+    (equivalent to a CONTIGUOUS-PREFIX key mask, the padded-batch case; the
+    caller is responsible for that contiguity). Query rows at padded
+    positions produce garbage the loss must mask, as with any
+    padding-masked attention.
     """
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
@@ -389,8 +417,18 @@ def flash_attention(
         sm_scale = float(1.0 / np.sqrt(d))
     if interpret is None:
         interpret = _default_interpret()
+    use_vl = kv_valid_lens is not None
+    if use_vl:
+        kv_valid_lens = jnp.asarray(kv_valid_lens, jnp.int32)
+        if kv_valid_lens.shape != (b,):
+            raise ValueError(
+                f"kv_valid_lens must be [batch]={b}, got "
+                f"{kv_valid_lens.shape}"
+            )
+    else:
+        kv_valid_lens = jnp.full((b,), s, jnp.int32)
 
-    def local(q, k, v):
+    def local(q, k, v, vls):
         lb, ls, lh, ld = q.shape
         # Non-block-multiple sequences (ViT's 197 tokens, BERT's 509, ...)
         # are right-padded to the block grid; padded kv columns are masked
@@ -410,9 +448,11 @@ def flash_attention(
             pad = lambda t: jnp.pad(t, ((0, 0), (0, ls_p - ls), (0, 0), (0, 0)))  # noqa: E731
             q, k, v = pad(q), pad(k), pad(v)
         to_bhsd = lambda t: t.transpose(0, 2, 1, 3).reshape(lb * lh, ls_p, ld)  # noqa: E731
+        # One limit per folded (batch, head) row, b-major like the fold.
+        vl_bh = jnp.repeat(vls, lh)
         o = _flash(
-            to_bhsd(q), to_bhsd(k), to_bhsd(v),
-            causal, sm_scale, bq, bk, interpret, valid,
+            to_bhsd(q), to_bhsd(k), to_bhsd(v), vl_bh,
+            causal, sm_scale, bq, bk, interpret, valid, use_vl,
         )
         o = o.reshape(lb, lh, ls_p, ld).transpose(0, 2, 1, 3)
         return o[:, :ls] if valid is not None else o
@@ -435,15 +475,16 @@ def flash_attention(
                     f"{'*'.join(head_axes)}={head_ways}"
                 )
             spec = P(BATCH_AXES, None, head_axes, None)
+            vl_spec = P(BATCH_AXES)
             # check_vma=False: same jax-0.9.0 pallas-in-shard_map typing
             # limitation as ring_attention_pallas.py — no collectives exist
             # in the body, each shard is independent.
             return jax.shard_map(
                 local, mesh=mesh,
-                in_specs=(spec, spec, spec), out_specs=spec,
+                in_specs=(spec, spec, spec, vl_spec), out_specs=spec,
                 check_vma=False,
-            )(q, k, v)
-    return local(q, k, v)
+            )(q, k, v, kv_valid_lens)
+    return local(q, k, v, kv_valid_lens)
 
 
 def attention_reference(q, k, v, *, causal: bool = False, sm_scale=None):

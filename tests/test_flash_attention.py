@@ -170,3 +170,89 @@ def test_transformer_flash_matches_xla():
         lambda a, b: np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4),
         gx, gf,
     )
+
+
+def test_kv_valid_lens_match_masked_reference():
+    # Per-sequence key-padding limits (the contiguous-prefix mask case):
+    # valid query rows must match a -inf-masked reference; padded rows are
+    # garbage by contract (the loss masks them).
+    def ref_attn(q, k, v, vl):
+        d = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        s = s / np.sqrt(d)
+        col = jnp.arange(s.shape[-1])
+        keep = col[None, None, None, :] < vl[:, None, None, None]
+        p = jax.nn.softmax(jnp.where(keep, s, -1e30), -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    q, k, v = _qkv(jax.random.PRNGKey(11), b=4, s=64, h=4, d=16)
+    vl = jnp.array([64, 37, 50, 12], jnp.int32)
+    ref = ref_attn(q, k, v, vl)
+    # block 32 -> 2 kv blocks, with vl values crossing block boundaries and
+    # one sequence (12 < 32) whose SECOND block is fully masked — exercises
+    # the online-softmax recurrence over masked trailing blocks.
+    for blocks in ({}, dict(block_q=32, block_k=32)):
+        out = flash_attention(q, k, v, kv_valid_lens=vl, **blocks)
+        for i in range(4):
+            n = int(vl[i])
+            np.testing.assert_allclose(
+                out[i, :n], ref[i, :n], atol=5e-5, rtol=5e-5
+            )
+    # Gradients with a validity-weighted loss (padded rows contribute 0).
+    wmask = (jnp.arange(64)[None, :] < vl[:, None]).astype(jnp.float32)
+    wmask = wmask[:, :, None, None]
+
+    def loss(fn):
+        return lambda q, k, v: ((fn(q, k, v) * wmask) ** 2).sum()
+
+    gf = jax.grad(
+        loss(lambda q, k, v: flash_attention(q, k, v, kv_valid_lens=vl)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        loss(lambda q, k, v: ref_attn(q, k, v, vl)), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+
+def test_transformer_flash_accepts_padding_mask():
+    # BERT-style: attn_impl='flash' with a [batch, k_len] contiguous-prefix
+    # mask must match the xla core on valid rows.
+    from distributeddeeplearning_tpu.models.transformer import TransformerStack
+
+    def make(impl):
+        return TransformerStack(
+            num_layers=2, num_heads=4, head_dim=16, mlp_dim=128,
+            causal=False, attn_impl=impl,
+        )
+
+    x = jax.random.normal(jax.random.PRNGKey(12), (2, 64, 64))
+    vl = jnp.array([64, 40], jnp.int32)
+    mask = (jnp.arange(64)[None, :] < vl[:, None]).astype(jnp.int32)
+    params = make("xla").init(jax.random.PRNGKey(13), x, mask)
+    out_x = make("xla").apply(params, x, mask)
+    out_f = make("flash").apply(params, x, mask)
+    for i in range(2):
+        n = int(vl[i])
+        np.testing.assert_allclose(
+            out_f[i, :n], out_x[i, :n], atol=2e-5, rtol=2e-5
+        )
+
+
+def test_non_prefix_mask_poisons_output_to_nan():
+    # Data-dependent contiguity can't raise under jit; the contract is that
+    # a non-prefix mask (e.g. left padding) produces NaNs, never silently
+    # wrong attention.
+    from distributeddeeplearning_tpu.models.transformer import SelfAttention
+
+    x = jax.random.normal(jax.random.PRNGKey(14), (2, 8, 64))
+    good = jnp.array([[1] * 8, [1] * 5 + [0] * 3], jnp.int32)
+    bad = jnp.array([[1] * 8, [0, 0, 1, 1, 1, 1, 1, 1]], jnp.int32)
+    attn = SelfAttention(num_heads=4, head_dim=16, attn_impl="flash")
+    params = attn.init(jax.random.PRNGKey(15), x, good)
+    out_good = attn.apply(params, x, good)
+    out_bad = attn.apply(params, x, bad)
+    assert np.isfinite(np.asarray(out_good)).all()
+    assert np.isnan(np.asarray(out_bad[1])).all()  # the left-padded row
+    assert np.isfinite(np.asarray(out_bad[0])).all()  # others untouched
