@@ -15,13 +15,18 @@ def get_config() -> Config:
     return Config(
         model=ModelConfig(
             name="bert",
-            kwargs={"size": "base", "vocab_size": 30522, "max_len": 512},
+            # Fused Pallas attention; padded batches supported via
+            # contiguous-prefix attention masks.
+            kwargs={
+                "size": "base", "vocab_size": 30522, "max_len": 512,
+                "attn_impl": "flash",
+            },
         ),
         data=DataConfig(
             kind="synthetic_mlm", batch_size=64, seq_len=128, vocab_size=30522,
         ),
         optim=OptimConfig(
-            name="adamw", lr=1e-4, weight_decay=0.01, schedule="linear",
+            name="adamw_fused", lr=1e-4, weight_decay=0.01, schedule="linear",
             warmup_steps=100, grad_clip=1.0,
         ),
         train=TrainConfig(

@@ -189,6 +189,20 @@ def _coerce(value, current, dotted: str):
 
 def _replace_nested(obj, parts: list[str], value, dotted: str = ""):
     field = parts[0]
+    if isinstance(obj, dict):
+        # Dict-valued config fields (model.kwargs): overrides may both
+        # replace existing keys (type-coerced) and introduce new ones —
+        # model kwargs legitimately vary per model.
+        if len(parts) == 1:
+            if field in obj:
+                value = _coerce(value, obj[field], dotted or field)
+            return {**obj, field: value}
+        if field not in obj:
+            raise KeyError(f"no key {field!r} in config dict ({dotted})")
+        return {
+            **obj,
+            field: _replace_nested(obj[field], parts[1:], value, dotted),
+        }
     if not dataclasses.is_dataclass(obj) or field not in {
         f.name for f in dataclasses.fields(obj)
     }:

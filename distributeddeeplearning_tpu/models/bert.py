@@ -27,6 +27,10 @@ class BertMLM(nn.Module):
     dropout_rate: float = 0.0
     remat: str = "none"
     dtype: jnp.dtype = jnp.float32
+    # 'flash' supports padded batches via contiguous-prefix attention_mask
+    # (see models/transformer.py SelfAttention).
+    attn_impl: str = "xla"
+    mesh: object = None  # required for the ring attn_impl variants
 
     @nn.compact
     def __call__(self, tokens, attention_mask=None, token_type_ids=None,
@@ -80,6 +84,8 @@ class BertMLM(nn.Module):
             dropout_rate=self.dropout_rate,
             remat=self.remat,
             dtype=self.dtype,
+            attn_impl=self.attn_impl,
+            mesh=self.mesh,
             name="encoder",
         )(x, attention_mask, not train)
 

@@ -93,3 +93,18 @@ def test_config_json_roundtrippable():
 
     blob = json.loads(Config().to_json())
     assert blob["model"]["name"] == "resnet18"
+
+
+def test_override_descends_into_model_kwargs():
+    from distributeddeeplearning_tpu.config import ModelConfig
+
+    cfg = Config(model=ModelConfig(name="gpt2", kwargs={"size": "124m"}))
+    out = apply_overrides(
+        cfg,
+        ["model.kwargs.size=tiny", "model.kwargs.vocab_size=512"],
+    )
+    assert out.model.kwargs["size"] == "tiny"  # replaced, string-coerced
+    assert out.model.kwargs["vocab_size"] == 512  # new key, literal int
+    # unknown nested path below a non-dict still fails loudly
+    with pytest.raises(KeyError):
+        apply_overrides(cfg, ["model.nope.x=1"])

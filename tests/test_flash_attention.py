@@ -256,3 +256,24 @@ def test_non_prefix_mask_poisons_output_to_nan():
     assert np.isfinite(np.asarray(out_good)).all()
     assert np.isnan(np.asarray(out_bad[1])).all()  # the left-padded row
     assert np.isfinite(np.asarray(out_bad[0])).all()  # others untouched
+
+
+def test_bert_flash_with_padding_matches_xla():
+    # End-to-end: BERT with attn_impl='flash' on a padded batch matches the
+    # xla core on valid positions.
+    from distributeddeeplearning_tpu import models
+
+    tokens = jax.random.randint(jax.random.PRNGKey(16), (2, 32), 0, 64)
+    mask = jnp.array([[1] * 32, [1] * 20 + [0] * 12], jnp.int32)
+    kw = dict(size="tiny", vocab_size=64, max_len=64, dropout_rate=0.0)
+    xla = models.get_model("bert", **kw)
+    flash = models.get_model("bert", attn_impl="flash", **kw)
+    params = xla.init(jax.random.PRNGKey(17), tokens, mask)
+    out_x = xla.apply(params, tokens, mask)
+    out_f = flash.apply(params, tokens, mask)
+    np.testing.assert_allclose(
+        out_f[0], out_x[0], atol=2e-4, rtol=2e-4
+    )
+    np.testing.assert_allclose(
+        out_f[1, :20], out_x[1, :20], atol=2e-4, rtol=2e-4
+    )
