@@ -1,0 +1,50 @@
+"""Expert-parallel workload: GPT-2-shaped Mixture-of-Experts LM.
+
+No MoE model appears in the reference's workload list (``BASELINE.json:6-12``),
+but expert parallelism is a mandated first-class strategy (SURVEY.md §2b) —
+this config makes it reachable from the CLI, not just from tests: every other
+block routes tokens over 8 experts sharded on the ``ep`` mesh axis
+(``models/moe.py``, ``parallel/ep.py``); the dispatch/combine einsums compile
+to XLA all-to-alls (asserted in ``tests/test_hlo_collectives.py``).
+
+Run (8-device CPU sim): ``python -m distributeddeeplearning_tpu.cli train
+--config configs/gpt2_moe.py --override mesh.ep=4 --override mesh.dp=2``.
+"""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(
+            name="gpt2_moe",
+            kwargs={
+                "size": "124m",
+                "max_len": 1024,
+                "num_experts": 8,
+                "num_selected": 2,
+                "capacity_factor": 1.25,
+                "moe_every": 2,
+            },
+        ),
+        data=DataConfig(
+            kind="synthetic_tokens", batch_size=32, seq_len=1024,
+            vocab_size=50257,
+        ),
+        optim=OptimConfig(
+            name="adamw", lr=6e-4, b2=0.95, weight_decay=0.1,
+            schedule="cosine", warmup_steps=200, grad_clip=1.0,
+        ),
+        train=TrainConfig(steps=1000, log_every=20, task="lm", zero1=True),
+        # ep shards experts; remaining devices go to dp. On one chip this
+        # degenerates to single-device (ep=1 via -1 absorption is invalid —
+        # ep must divide num_experts, so keep ep explicit when scaling out).
+        mesh=MeshConfig(dp=-1, ep=1),
+    )

@@ -78,17 +78,9 @@ def make_eval_fn(cfg: Config, mesh, dataset=None):
     already-built eval dataset instead of constructing a second one."""
     import itertools
 
-    if "path" in cfg.data.dataset_kwargs() and not cfg.data.eval_path:
-        # File-backed kind with no held-out file: the "eval" batches are the
-        # first N training batches. Silently reporting training loss as
-        # eval_* is exactly the failure config.py rejects for eval_seed —
-        # make this variant impossible to miss too (ADVICE r2 #2).
-        print(
-            "WARNING: eval is enabled but data.eval_path is unset — eval_* "
-            f"metrics will be computed on the TRAINING file ({cfg.data.path!r}). "
-            "Set data.eval_path to a held-out file for a real eval split.",
-            flush=True,
-        )
+    # File-backed kind with no held-out file: config.eval_dataset_kwargs
+    # prints a loud training-file warning when it builds the kwargs below
+    # (ADVICE r2 #2) — no separate CLI-level warning needed.
     eval_ds = dataset if dataset is not None else data_lib.make_dataset(
         cfg.data.kind, **cfg.data.eval_dataset_kwargs()
     )

@@ -12,6 +12,7 @@ import ast
 import dataclasses
 import importlib.util
 import json
+import sys
 from typing import Any
 
 from .mesh import MeshConfig
@@ -84,6 +85,17 @@ class DataConfig:
                     f"data.eval_seed with file-backed kind {self.kind!r} only "
                     "reshuffles the training file — set data.eval_path to a "
                     "held-out file instead"
+                )
+            else:
+                # Without a held-out file there is no eval split to draw
+                # from: every eval_* metric would be training loss in
+                # disguise. Say so loudly rather than report it silently.
+                print(
+                    f"WARNING: file-backed kind {self.kind!r} has no "
+                    "data.eval_path — eval_* metrics will be computed on "
+                    "the TRAINING file (training loss, not held-out eval)",
+                    file=sys.stderr,
+                    flush=True,
                 )
             return kwargs
         if self.eval_seed >= 0 and "seed" in kwargs:

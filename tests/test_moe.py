@@ -131,3 +131,31 @@ class TestExpertParallelParity:
             for a, b in zip(routers(state.params), before)
         ]
         assert max(moved) > 0.0
+
+
+def test_moe_config_trains_via_cli(capsys):
+    """EP is CLI-reachable: configs/gpt2_moe.py (tiny-overridden, ep=2 on
+    the 8-device sim) trains end-to-end through cmd_train."""
+    from distributeddeeplearning_tpu.cli import cmd_train
+    from distributeddeeplearning_tpu.config import apply_overrides, load_config
+
+    cfg = apply_overrides(
+        load_config("configs/gpt2_moe.py"),
+        [
+            "model.kwargs.size=tiny",
+            "model.kwargs.max_len=32",
+            "model.kwargs.num_experts=4",
+            "model.kwargs.vocab_size=64",
+            "data.batch_size=8",
+            "data.seq_len=16",
+            "data.vocab_size=64",
+            "train.steps=3",
+            "train.log_every=1",
+            "train.zero1=False",
+            "mesh.ep=2",
+            "mesh.dp=4",
+        ],
+    )
+    assert cmd_train(cfg) == 0
+    out = capsys.readouterr().out
+    assert "'ep': 2" in out and "loss" in out

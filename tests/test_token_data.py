@@ -137,6 +137,21 @@ def test_eval_split_for_file_kinds(token_file, tmp_path):
         bad.eval_dataset_kwargs()
 
 
+def test_eval_without_heldout_file_warns_loudly(token_file, capsys):
+    from distributeddeeplearning_tpu.config import DataConfig
+
+    # Neither eval_path nor eval_seed on a file kind: eval falls back to
+    # the training file, which must be announced, not silent (it makes
+    # every eval_* metric a training-loss number in disguise).
+    cfg = DataConfig(
+        kind="token_file_lm", batch_size=4, seq_len=32, path=token_file,
+    )
+    kwargs = cfg.eval_dataset_kwargs()
+    assert kwargs["path"] == token_file
+    err = capsys.readouterr().err
+    assert "TRAINING file" in err and "eval_path" in err
+
+
 def test_gpt2_trains_from_token_file(token_file, mesh8):
     ds = TokenFileLM(path=token_file, batch_size=16, seq_len=32, seed=0)
     model = models.get_model("gpt2", size="tiny", vocab_size=256, max_len=64)

@@ -28,8 +28,25 @@ RUNS = [
 
 
 def main() -> int:
+    # The chip wedges intermittently MID-RUN (observed: a measurement job
+    # silent for 50 min) — write TPU_NUMBERS.json after EVERY config so a
+    # wedge only loses the in-flight measurement, and merge with whatever a
+    # previous partial run already captured.
+    out_path = os.path.join(_REPO, "TPU_NUMBERS.json")
     out = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                out = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            out = {}  # truncated partial write: start over, don't crash
+        if not isinstance(out, dict):
+            out = {}  # valid JSON but not an object: same recovery
     for name, overrides, warmup, steps in RUNS:
+        prev = out.get(name)
+        if isinstance(prev, dict) and prev and "error" not in prev:
+            print("SKIP", name, "(already measured)", flush=True)
+            continue
         try:
             cfg = apply_overrides(
                 load_config(os.path.join(_REPO, "configs", f"{name}.py")),
@@ -41,9 +58,11 @@ def main() -> int:
         except Exception as e:  # keep measuring the rest
             out[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
             print("RESULT", name, "FAILED", out[name]["error"], flush=True)
-    with open(os.path.join(_REPO, "TPU_NUMBERS.json"), "w") as f:
-        json.dump(out, f, indent=2)
-        f.write("\n")
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, out_path)  # atomic: a kill mid-dump can't truncate
     return 0
 
 
