@@ -20,6 +20,9 @@ def get_config() -> Config:
             kwargs={
                 "size": "base", "vocab_size": 30522, "max_len": 512,
                 "attn_impl": "flash",
+                # MLM loss via chunked cross-entropy — the [64, 128, 30522]
+                # fp32 logits (~1 GB) never materialize (ops/chunked_xent.py).
+                "chunked_head": True,
             },
         ),
         data=DataConfig(

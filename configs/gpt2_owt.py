@@ -21,6 +21,10 @@ def get_config() -> Config:
                 # Fused Pallas attention on the hot path; runs under
                 # shard_map over (dp,fsdp)×tp (ops/flash_attention.py).
                 "attn_impl": "flash",
+                # Never materialize the [32, 1024, 50257] fp32 logits
+                # (~6.6 GB HBM): chunked cross-entropy over the sequence
+                # (ops/chunked_xent.py, train.head_chunk positions/step).
+                "chunked_head": True,
             },
         ),
         data=DataConfig(

@@ -25,7 +25,11 @@ def get_config() -> Config:
     return Config(
         model=ModelConfig(
             name="gpt2",
-            kwargs={"size": "124m", "max_len": 1024, "attn_impl": "flash"},
+            kwargs={
+                "size": "124m", "max_len": 1024, "attn_impl": "flash",
+                # Chunked cross-entropy head — see configs/gpt2_owt.py.
+                "chunked_head": True,
+            },
         ),
         data=DataConfig(
             kind="token_file_lm", batch_size=32, seq_len=1024,

@@ -59,7 +59,8 @@ def build_all(cfg: Config, split: str = "train"):
     trainer = Trainer(
         model,
         tx,
-        get_task(cfg.train.task),
+        # get_task drops knobs a task's factory doesn't declare.
+        get_task(cfg.train.task, head_chunk=cfg.train.head_chunk),
         mesh,
         grad_accum=cfg.train.grad_accum,
         zero1=cfg.train.zero1,

@@ -129,6 +129,9 @@ class TrainConfig:
     save_every: int = 0
     eval_every: int = 0  # run the eval loop every K steps (0 = off)
     eval_batches: int = 8  # batches per eval pass
+    # lm/mlm with a chunked_head model: sequence positions per chunked
+    # cross-entropy scan step (ops/chunked_xent.py). Ignored otherwise.
+    head_chunk: int = 128
     log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
     # Debug/fault tooling (SURVEY §5): the XLA-world equivalents of the

@@ -31,6 +31,10 @@ class BertMLM(nn.Module):
     # (see models/transformer.py SelfAttention).
     attn_impl: str = "xla"
     mesh: object = None  # required for the ring attn_impl variants
+    # True: return the transformed hidden states + tied decoder (+ bias)
+    # instead of [B, L, V] logits — the tasks then compute the MLM loss
+    # via the chunked cross-entropy (ops/chunked_xent.py).
+    chunked_head: bool = False
 
     @nn.compact
     def __call__(self, tokens, attention_mask=None, token_type_ids=None,
@@ -102,12 +106,18 @@ class BertMLM(nn.Module):
         )(x)
         x = gelu_exact(x)
         x = layer_norm(1e-12, self.dtype, "mlm_ln")(x)
-        logits = word.attend(x)
         bias = self.param(
             "mlm_bias",
             nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
             (self.vocab_size,),
         )
+        if self.chunked_head:
+            from ..ops.chunked_xent import head_output
+
+            return head_output(
+                x, jnp.asarray(word.embedding, self.dtype), bias
+            )
+        logits = word.attend(x)
         return (logits + bias).astype(jnp.float32)
 
 

@@ -33,6 +33,10 @@ class GPT2(nn.Module):
     attn_impl: str = "xla"  # xla | ulysses | ulysses_flash | ring |
     # ring_pallas | flash (see models/transformer.py)
     mesh: object = None  # required for the ring attn_impl variants
+    # True: skip the [B, L, V] logits materialization — return the final
+    # hidden states + tied decoder for the tasks' chunked cross-entropy
+    # (ops/chunked_xent.py; saves ~6.6 GB HBM at the 124m bench config).
+    chunked_head: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -78,6 +82,10 @@ class GPT2(nn.Module):
             name="h",
         )(x, None, not train)
         x = layer_norm(1e-5, self.dtype, "ln_f")(x)
+        if self.chunked_head:
+            from ..ops.chunked_xent import head_output
+
+            return head_output(x, jnp.asarray(wte.embedding, self.dtype))
         # Tied LM head (GPT-2 shares wte with the output projection).
         logits = wte.attend(x)
         return logits.astype(jnp.float32)

@@ -189,6 +189,9 @@ class MoeGPT2(nn.Module):
     moe_every: int = 2
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    # True: return hidden states + tied decoder for the tasks' chunked
+    # cross-entropy instead of [B, L, V] logits (ops/chunked_xent.py).
+    chunked_head: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -247,6 +250,10 @@ class MoeGPT2(nn.Module):
                     name=f"block_{i}",
                 )(x, None, not train)
         x = layer_norm(1e-5, self.dtype, "ln_f")(x)
+        if self.chunked_head:
+            from ..ops.chunked_xent import head_output
+
+            return head_output(x, jnp.asarray(wte.embedding, self.dtype))
         logits = wte.attend(x)
         return logits.astype(jnp.float32)
 

@@ -83,15 +83,26 @@ def classification_task() -> Task:
     return Task(input_fn=lambda b: (b["image"],), loss_fn=loss_fn)
 
 
-def lm_task() -> Task:
+def _per_token_xent(model_out, targets, head_chunk: int):
+    """Per-token xent for either head form: full [B, L, V] logits, or a
+    chunked-head dict (``chunked_head=True`` models) that never
+    materializes them (ops/chunked_xent.py)."""
+    from .ops.chunked_xent import chunked_xent, is_chunked_head
+
+    if is_chunked_head(model_out):
+        return chunked_xent(model_out, targets, seq_chunk=head_chunk)
+    return _xent(model_out, targets)
+
+
+def lm_task(head_chunk: int = 128) -> Task:
     """Causal LM: predict tokens[1:] from tokens[:-1]."""
 
     def input_fn(batch):
         return (batch["tokens"][:, :-1],)
 
-    def loss_fn(logits, batch):
+    def loss_fn(out, batch):
         targets = batch["tokens"][:, 1:]
-        loss = _xent(logits, targets).mean()
+        loss = _per_token_xent(out, targets, head_chunk).mean()
         # exp(mean xent) — the LM eval metric; computed on-device, so the
         # eval loop's batch-mean of it is the standard per-batch-ppl mean.
         return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
@@ -99,25 +110,34 @@ def lm_task() -> Task:
     return Task(input_fn=input_fn, loss_fn=loss_fn)
 
 
-def mlm_task() -> Task:
+def mlm_task(head_chunk: int = 128) -> Task:
     """Masked LM: loss only on masked positions (labels == -1 is ignored)."""
 
-    def loss_fn(logits, batch):
+    def loss_fn(out, batch):
         labels = batch["labels"]
         weights = (labels >= 0).astype(jnp.float32)
-        per_tok = _xent(logits, jnp.maximum(labels, 0)) * weights
-        loss = per_tok.sum() / jnp.maximum(weights.sum(), 1.0)
+        per_tok = _per_token_xent(out, jnp.maximum(labels, 0), head_chunk)
+        loss = (per_tok * weights).sum() / jnp.maximum(weights.sum(), 1.0)
         return loss, {"loss": loss, "masked_fraction": weights.mean()}
 
     return Task(input_fn=lambda b: (b["input_tokens"],), loss_fn=loss_fn)
 
 
-def get_task(name: str) -> Task:
-    return {
+def get_task(name: str, **task_kwargs) -> Task:
+    """``task_kwargs``: per-task knobs (lm/mlm: ``head_chunk`` — sequence
+    positions per chunked-xent scan step when the model opts into
+    ``chunked_head``; ignored for full-logits models). Knobs a task's
+    factory doesn't declare are dropped, so callers can pass the full
+    knob set without tracking which task takes what."""
+    import inspect
+
+    factory = {
         "classification": classification_task,
         "lm": lm_task,
         "mlm": mlm_task,
-    }[name]()
+    }[name]
+    params = inspect.signature(factory).parameters
+    return factory(**{k: v for k, v in task_kwargs.items() if k in params})
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,186 @@
+"""Chunked LM-head cross-entropy (ops/chunked_xent.py) — parity with the
+full-logits path at op, model, and sharded-trainer level.
+
+The op exists to remove the [B, L, V] logits tensor from the GPT-2/BERT
+train step without changing a single number; every test therefore pins
+equality against the unchunked computation, including gradients (the
+``jax.checkpoint`` recompute path is where a subtle bug would hide).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.data import (
+    SyntheticMLM,
+    SyntheticTokens,
+    sharded_batches,
+)
+from distributeddeeplearning_tpu.ops.chunked_xent import (
+    chunked_xent,
+    head_output,
+    is_chunked_head,
+)
+from distributeddeeplearning_tpu.train import (
+    Trainer,
+    get_task,
+    make_optimizer,
+)
+
+from helpers import mesh_of
+
+
+def _ref_per_tok(hidden, emb, targets, bias=None):
+    logits = jnp.einsum("ble,ve->blv", hidden, emb)
+    if bias is not None:
+        logits = logits + bias
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+
+
+class TestOp:
+    def _inputs(self, B=2, L=12, E=8, V=32, seed=0):
+        k = jax.random.PRNGKey(seed)
+        kh, ke, kt, kb = jax.random.split(k, 4)
+        hidden = jax.random.normal(kh, (B, L, E))
+        emb = jax.random.normal(ke, (V, E)) * 0.1
+        targets = jax.random.randint(kt, (B, L), 0, V)
+        bias = jax.random.normal(kb, (V,)) * 0.1
+        return hidden, emb, targets, bias
+
+    @pytest.mark.parametrize("seq_chunk", [1, 4, 5, 12, 64])
+    def test_forward_parity_all_chunkings(self, seq_chunk):
+        # 5 and 64 exercise the pad path (12 % 5 != 0) and the clamp.
+        hidden, emb, targets, _ = self._inputs()
+        got = chunked_xent(
+            head_output(hidden, emb), targets, seq_chunk=seq_chunk
+        )
+        want = _ref_per_tok(hidden, emb, targets)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_forward_parity_with_bias(self):
+        hidden, emb, targets, bias = self._inputs()
+        got = chunked_xent(
+            head_output(hidden, emb, bias), targets, seq_chunk=4
+        )
+        want = _ref_per_tok(hidden, emb, targets, bias)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_grad_parity_including_recompute(self):
+        hidden, emb, targets, bias = self._inputs()
+
+        def chunked(h, e, b):
+            return chunked_xent(
+                head_output(h, e, b), targets, seq_chunk=5
+            ).mean()
+
+        def full(h, e, b):
+            return _ref_per_tok(h, e, targets, b).mean()
+
+        gc = jax.grad(chunked, argnums=(0, 1, 2))(hidden, emb, bias)
+        gf = jax.grad(full, argnums=(0, 1, 2))(hidden, emb, bias)
+        for a, b in zip(gc, gf):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+class TestModelParity:
+    """chunked_head=True must be numerically invisible end to end."""
+
+    def _losses(self, name, task, ds, mesh, steps=3, **kw):
+        model = models.get_model(name, size="tiny", vocab_size=64,
+                                 max_len=32, **kw)
+        trainer = Trainer(
+            model, make_optimizer("adamw", 1e-3), get_task(task,
+                                                           head_chunk=5),
+            mesh, donate=False,
+        )
+        state = trainer.init(0, ds.batch(0))
+        losses = []
+        for _, batch in zip(range(steps),
+                            sharded_batches(ds.iter_from(0), mesh)):
+            state, m = trainer.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    def test_gpt2_lm_single_device(self, mesh1):
+        ds = SyntheticTokens(batch_size=8, seq_len=16, vocab_size=64)
+        full = self._losses("gpt2", "lm", ds, mesh1)
+        chunked = self._losses("gpt2", "lm", ds, mesh1, chunked_head=True)
+        np.testing.assert_allclose(chunked, full, rtol=1e-5)
+
+    def test_bert_mlm_single_device(self, mesh1):
+        ds = SyntheticMLM(batch_size=8, seq_len=16, vocab_size=64)
+        full = self._losses("bert", "mlm", ds, mesh1)
+        chunked = self._losses("bert", "mlm", ds, mesh1, chunked_head=True)
+        np.testing.assert_allclose(chunked, full, rtol=1e-5)
+
+    def test_gpt2_moe_single_device(self, mesh1):
+        ds = SyntheticTokens(batch_size=8, seq_len=16, vocab_size=64)
+        full = self._losses("gpt2_moe", "lm", ds, mesh1, num_experts=4)
+        chunked = self._losses("gpt2_moe", "lm", ds, mesh1, num_experts=4,
+                               chunked_head=True)
+        np.testing.assert_allclose(chunked, full, rtol=1e-5)
+
+    def test_gpt2_dp_tp_mesh_matches_single_device(self, mesh1,
+                                                   mesh_factory):
+        # The op is plain XLA, so GSPMD must partition it like any head:
+        # dp2×tp2×fsdp2 chunked losses == single-device chunked losses.
+        ds = SyntheticTokens(batch_size=8, seq_len=16, vocab_size=64)
+        single = self._losses("gpt2", "lm", ds, mesh1, chunked_head=True)
+        meshed = self._losses(
+            "gpt2", "lm", ds, mesh_of(dp=2, fsdp=2, tp=2),
+            chunked_head=True,
+        )
+        np.testing.assert_allclose(meshed, single, rtol=1e-4)
+
+
+def test_chunked_head_shrinks_compiled_temp_memory(mesh1):
+    # The whole point: the compiled train step must hold less live memory
+    # without the [B, L, V] logits (+ their fp32 backward residents). At
+    # B=4, L=256, V=8192 the full-logits step carries ~33 MB of logits
+    # alone; chunked (Lc=32) keeps 1/8th of one block.
+    ds = SyntheticTokens(batch_size=4, seq_len=256, vocab_size=8192)
+
+    def temp_bytes(chunked):
+        model = models.get_model(
+            "gpt2", size="tiny", vocab_size=8192, max_len=256,
+            chunked_head=chunked,
+        )
+        trainer = Trainer(
+            model, make_optimizer("adamw", 1e-3),
+            get_task("lm", head_chunk=32), mesh1, donate=False,
+        )
+        state = trainer.init(0, ds.batch(0))
+        batch = next(iter(sharded_batches(ds.iter_from(0), mesh1)))
+        compiled = trainer.train_step.lower(state, batch).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    assert temp_bytes(True) < 0.6 * temp_bytes(False)
+
+
+def test_cli_head_chunk_reaches_task(mesh_factory):
+    """configs wire chunked_head → model and head_chunk → task."""
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import apply_overrides, load_config
+
+    cfg = apply_overrides(
+        load_config("configs/gpt2_owt.py"),
+        [
+            "model.kwargs.size=tiny", "model.kwargs.max_len=32",
+            "model.kwargs.vocab_size=64", "model.kwargs.attn_impl=xla",
+            "model.kwargs.chunked_head=True",
+            "data.batch_size=8", "data.seq_len=16", "data.vocab_size=64",
+            "train.head_chunk=4", "train.zero1=False",
+            "optim.name=adamw",
+        ],
+    )
+    mesh, model, trainer, ds = build_all(cfg)
+    assert model.chunked_head
+    state = trainer.init(0, ds.batch(0))
+    batch = next(iter(sharded_batches(ds.iter_from(0), mesh)))
+    state, m = trainer.train_step(state, batch)
+    assert np.isfinite(float(m["loss"]))

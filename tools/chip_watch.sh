@@ -1,19 +1,38 @@
 #!/bin/bash
 # The attached TPU intermittently wedges at backend init (see BASELINE.md's
-# chip-health log). This watcher probes every 10 minutes and, on recovery,
-# runs tools/measure_tpu.py once to populate TPU_NUMBERS.json with the
+# chip-health log). This watcher probes every 10 minutes and, while the chip
+# is up, runs tools/measure_tpu.py to populate TPU_NUMBERS.json with the
 # per-config real-chip measurements BASELINE.md's table is waiting on.
+# measure_tpu.py resumes incrementally (skips configs already measured), so
+# a mid-measure wedge just means the next healthy probe picks up where it
+# left off. The loop ends once every config has an error-free record.
 #
 #   nohup tools/chip_watch.sh > /tmp/chip_watch.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
-for i in $(seq 1 30); do
-  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "chip alive — measuring"
-    timeout 2400 python tools/measure_tpu.py
+
+# Completion lives in measure_tpu.py itself (--check): one source of truth
+# for the config list and record validity (incl. config fingerprints).
+done_yet() {
+  python tools/measure_tpu.py --check >/dev/null 2>&1
+}
+
+for i in $(seq 1 40); do
+  if done_yet; then
+    echo "all configs measured — done"
     exit 0
   fi
-  echo "probe $i: wedged"
-  sleep 600
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "probe $i: chip alive — measuring"
+    timeout 2400 python tools/measure_tpu.py
+    sleep 60  # a persistently-failing config must not hot-loop
+  else
+    echo "probe $i: wedged"
+    sleep 600
+  fi
 done
-echo "gave up after 30 probes"
+if done_yet; then
+  echo "all configs measured — done"
+  exit 0
+fi
+echo "gave up after 40 probes"
 exit 1

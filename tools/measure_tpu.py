@@ -2,20 +2,20 @@
 ``benchmark.run_benchmark`` on the attached chip and write TPU_NUMBERS.json
 at the repo root. Run directly (chip must be healthy) or via
 ``tools/chip_watch.sh``, which probes the intermittently-wedging chip and
-fires this on recovery."""
+fires this on recovery.
 
+``--check`` exits 0 iff every RUNS entry already has a valid record —
+the single source of truth the watcher loops on (no second copy of the
+config list in shell).
+"""
+
+import hashlib
 import json
 import os
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
-
-from distributeddeeplearning_tpu.benchmark import run_benchmark  # noqa: E402
-from distributeddeeplearning_tpu.config import (  # noqa: E402
-    apply_overrides,
-    load_config,
-)
 
 # (config, overrides, warmup, timed steps)
 RUNS = [
@@ -26,26 +26,65 @@ RUNS = [
     ("vit_imagenet21k", [], 3, 10),
 ]
 
+_OUT_PATH = os.path.join(_REPO, "TPU_NUMBERS.json")
+
+
+def _fingerprint(name: str, overrides: list) -> str:
+    """Identity of what a record measured: the config file bytes + the
+    overrides. A committed change to the config (new kernel flag, batch
+    size, ...) invalidates the old number — BASELINE.md must never
+    attribute pre-change measurements to the post-change config."""
+    with open(os.path.join(_REPO, "configs", f"{name}.py"), "rb") as f:
+        h = hashlib.sha256(f.read())
+    h.update(json.dumps(overrides).encode())
+    return h.hexdigest()[:16]
+
+
+def _load_records() -> dict:
+    if not os.path.exists(_OUT_PATH):
+        return {}
+    try:
+        with open(_OUT_PATH) as f:
+            out = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return {}  # truncated partial write: start over, don't crash
+    return out if isinstance(out, dict) else {}
+
+
+def _is_current(record, name: str, overrides: list) -> bool:
+    return (
+        isinstance(record, dict)
+        and bool(record)
+        and "error" not in record
+        and record.get("config_fingerprint") == _fingerprint(name, overrides)
+    )
+
+
+def check() -> int:
+    out = _load_records()
+    missing = [
+        name for name, overrides, _, _ in RUNS
+        if not _is_current(out.get(name), name, overrides)
+    ]
+    if missing:
+        print("pending:", " ".join(missing))
+        return 1
+    return 0
+
 
 def main() -> int:
+    from distributeddeeplearning_tpu.benchmark import run_benchmark
+    from distributeddeeplearning_tpu.config import apply_overrides, load_config
+
     # The chip wedges intermittently MID-RUN (observed: a measurement job
     # silent for 50 min) — write TPU_NUMBERS.json after EVERY config so a
     # wedge only loses the in-flight measurement, and merge with whatever a
     # previous partial run already captured.
-    out_path = os.path.join(_REPO, "TPU_NUMBERS.json")
-    out = {}
-    if os.path.exists(out_path):
-        try:
-            with open(out_path) as f:
-                out = json.load(f)
-        except (json.JSONDecodeError, OSError):
-            out = {}  # truncated partial write: start over, don't crash
-        if not isinstance(out, dict):
-            out = {}  # valid JSON but not an object: same recovery
+    out = _load_records()
     for name, overrides, warmup, steps in RUNS:
-        prev = out.get(name)
-        if isinstance(prev, dict) and prev and "error" not in prev:
-            print("SKIP", name, "(already measured)", flush=True)
+        if _is_current(out.get(name), name, overrides):
+            print("SKIP", name, "(already measured, config unchanged)",
+                  flush=True)
             continue
         try:
             cfg = apply_overrides(
@@ -53,18 +92,19 @@ def main() -> int:
                 overrides,
             )
             record = run_benchmark(cfg, warmup=warmup, steps=steps)
+            record["config_fingerprint"] = _fingerprint(name, overrides)
             out[name] = record
             print("RESULT", name, json.dumps(record), flush=True)
         except Exception as e:  # keep measuring the rest
             out[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
             print("RESULT", name, "FAILED", out[name]["error"], flush=True)
-        tmp = out_path + ".tmp"
+        tmp = _OUT_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump(out, f, indent=2)
             f.write("\n")
-        os.replace(tmp, out_path)  # atomic: a kill mid-dump can't truncate
+        os.replace(tmp, _OUT_PATH)  # atomic: a kill mid-dump can't truncate
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(check() if "--check" in sys.argv[1:] else main())
