@@ -23,6 +23,8 @@ def get_config() -> Config:
                 # MLM loss via chunked cross-entropy — the [64, 128, 30522]
                 # fp32 logits (~1 GB) never materialize (ops/chunked_xent.py).
                 "chunked_head": True,
+                # bf16 compute, fp32 params/accum — the TPU MXU dtype.
+                "dtype": "bfloat16",
             },
         ),
         data=DataConfig(

@@ -25,6 +25,8 @@ def get_config() -> Config:
                 # (~6.6 GB HBM): chunked cross-entropy over the sequence
                 # (ops/chunked_xent.py, train.head_chunk positions/step).
                 "chunked_head": True,
+                # bf16 compute, fp32 params/accum — the TPU MXU dtype.
+                "dtype": "bfloat16",
             },
         ),
         data=DataConfig(

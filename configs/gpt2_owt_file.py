@@ -29,6 +29,7 @@ def get_config() -> Config:
                 "size": "124m", "max_len": 1024, "attn_impl": "flash",
                 # Chunked cross-entropy head — see configs/gpt2_owt.py.
                 "chunked_head": True,
+                "dtype": "bfloat16",
             },
         ),
         data=DataConfig(

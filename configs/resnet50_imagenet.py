@@ -18,7 +18,7 @@ from distributeddeeplearning_tpu.mesh import MeshConfig
 
 def get_config() -> Config:
     return Config(
-        model=ModelConfig(name="resnet50", kwargs={"num_classes": 1000}),
+        model=ModelConfig(name="resnet50", kwargs={"num_classes": 1000, "dtype": "bfloat16"}),
         data=DataConfig(
             kind="synthetic_image",
             batch_size=256,

@@ -16,7 +16,7 @@ def get_config() -> Config:
         model=ModelConfig(
             # Fused Pallas attention: the 197-token sequence is padded to
             # the kernel's block grid with masked padding columns.
-            name="vit", kwargs={"size": "l16", "attn_impl": "flash"}
+            name="vit", kwargs={"size": "l16", "attn_impl": "flash", "dtype": "bfloat16"}
         ),
         data=DataConfig(
             kind="synthetic_image", batch_size=64, image_size=224,

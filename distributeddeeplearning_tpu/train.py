@@ -126,16 +126,25 @@ def mlm_task(head_chunk: int = 128) -> Task:
 def get_task(name: str, **task_kwargs) -> Task:
     """``task_kwargs``: per-task knobs (lm/mlm: ``head_chunk`` — sequence
     positions per chunked-xent scan step when the model opts into
-    ``chunked_head``; ignored for full-logits models). Knobs a task's
-    factory doesn't declare are dropped, so callers can pass the full
-    knob set without tracking which task takes what."""
+    ``chunked_head``; ignored for full-logits models). A knob another
+    task declares is dropped for tasks that don't take it (callers pass
+    the full knob set); a knob NO task declares is a loud TypeError, so
+    a wiring typo can't silently train with defaults."""
     import inspect
 
-    factory = {
+    factories = {
         "classification": classification_task,
         "lm": lm_task,
         "mlm": mlm_task,
-    }[name]
+    }
+    known = {
+        p for f in factories.values()
+        for p in inspect.signature(f).parameters
+    }
+    unknown = set(task_kwargs) - known
+    if unknown:
+        raise TypeError(f"unknown task knob(s) {sorted(unknown)}")
+    factory = factories[name]
     params = inspect.signature(factory).parameters
     return factory(**{k: v for k, v in task_kwargs.items() if k in params})
 

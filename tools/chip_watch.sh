@@ -16,13 +16,22 @@ done_yet() {
   python tools/measure_tpu.py --check >/dev/null 2>&1
 }
 
+# Separate budgets: wedge probes are cheap (2 min), measurement attempts
+# are not (up to 40 min) — a deterministically-failing config must not
+# hammer the shared chip for days.
+measure_attempts=0
 for i in $(seq 1 40); do
   if done_yet; then
     echo "all configs measured — done"
     exit 0
   fi
+  if [ "$measure_attempts" -ge 5 ]; then
+    echo "5 measurement attempts exhausted without completing — giving up"
+    exit 1
+  fi
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "probe $i: chip alive — measuring"
+    measure_attempts=$((measure_attempts + 1))
+    echo "probe $i: chip alive — measuring (attempt $measure_attempts)"
     timeout 2400 python tools/measure_tpu.py
     sleep 60  # a persistently-failing config must not hot-loop
   else

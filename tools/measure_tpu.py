@@ -51,13 +51,17 @@ def _load_records() -> dict:
     return out if isinstance(out, dict) else {}
 
 
+def _is_measurement(record) -> bool:
+    return isinstance(record, dict) and bool(record) and "error" not in record
+
+
 def _is_current(record, name: str, overrides: list) -> bool:
-    return (
-        isinstance(record, dict)
-        and bool(record)
-        and "error" not in record
-        and record.get("config_fingerprint") == _fingerprint(name, overrides)
-    )
+    if not _is_measurement(record):
+        return False
+    try:
+        return record.get("config_fingerprint") == _fingerprint(name, overrides)
+    except OSError:  # config file missing/renamed: re-measure, don't crash
+        return False
 
 
 def check() -> int:
@@ -96,8 +100,13 @@ def main() -> int:
             out[name] = record
             print("RESULT", name, json.dumps(record), flush=True)
         except Exception as e:  # keep measuring the rest
-            out[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
-            print("RESULT", name, "FAILED", out[name]["error"], flush=True)
+            failed = {"error": f"{type(e).__name__}: {e}"[:500]}
+            if _is_measurement(out.get(name)):
+                # A stale-but-real prior measurement beats nothing: keep it
+                # alongside the error instead of destroying it.
+                failed["previous"] = out[name]
+            out[name] = failed
+            print("RESULT", name, "FAILED", failed["error"], flush=True)
         tmp = _OUT_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump(out, f, indent=2)
