@@ -79,6 +79,14 @@ struct Config {
   int64_t record_bytes = 0;
   int64_t label_bytes = 0;  // leading bytes holding the label (LE int)
   bool shuffle = true;
+  // Training augmentation (random zero-pad+crop / horizontal flip) applied
+  // by the worker threads — bit-exact with data.augment_images (same
+  // splitmix64 draw per GLOBAL sample index, same crop geometry), so the
+  // numpy and native paths stay interchangeable mid-training.
+  bool aug = false;
+  int64_t aug_pad = 4;
+  int64_t img_h = 0, img_w = 0, img_c = 0;
+  bool chw = true;  // payload layout: channel-major (CIFAR) vs pixel-major
 };
 
 class Loader {
@@ -120,6 +128,16 @@ class Loader {
   ~Loader() { Stop(); }
 
   int64_t num_records() const { return num_records_; }
+
+  // Call before Start()/Fill(): workers read these fields unlocked.
+  void EnableAugment(int64_t pad, int64_t h, int64_t w, int64_t c, bool chw) {
+    cfg_.aug = true;
+    cfg_.aug_pad = pad;
+    cfg_.img_h = h;
+    cfg_.img_w = w;
+    cfg_.img_c = c;
+    cfg_.chw = chw;
+  }
 
   // Fill caller buffers synchronously with batch `index` (used for
   // batch(i) shape probes and as the determinism oracle in tests).
@@ -265,8 +283,50 @@ class Loader {
         labels[i] = static_cast<int32_t>(label);
         float* out = data + i * cfg_.sample_floats;
         const uint8_t* s = p + cfg_.label_bytes;
-        for (int64_t b = 0; b < payload; ++b)
-          out[b] = s[b] * (1.0f / 255.0f);
+        if (cfg_.aug) {
+          AugmentSample(global, s, out);
+        } else {
+          for (int64_t b = 0; b < payload; ++b)
+            out[b] = s[b] * (1.0f / 255.0f);
+        }
+      }
+    }
+  }
+
+  // Random zero-pad+crop and horizontal flip for one sample, gathered
+  // directly from the uint8 payload into the normalized float output.
+  // The (dy, dx, flip) draw is data.augment_bits verbatim:
+  //   h = splitmix64(global ^ splitmix64(seed)); span = 2*pad + 1;
+  //   dy = h % span; dx = (h >> 16) % span; flip = (h >> 32) & 1.
+  // Output pixel (y, x) reads padded(dy + y, dx + x), i.e. source
+  // (dy + y - pad, dx + x' - pad) with x' pre-flipped, zeros outside.
+  void AugmentSample(int64_t global, const uint8_t* s, float* out) const {
+    const int64_t H = cfg_.img_h, W = cfg_.img_w, C = cfg_.img_c;
+    const int64_t pad = cfg_.aug_pad;
+    const uint64_t span = static_cast<uint64_t>(2 * pad + 1);
+    const uint64_t h64 =
+        splitmix64(static_cast<uint64_t>(global) ^ splitmix64(cfg_.seed));
+    const int64_t dy = static_cast<int64_t>(h64 % span);
+    const int64_t dx = static_cast<int64_t>((h64 >> 16) % span);
+    const bool flip = ((h64 >> 32) & 1ull) != 0;
+    for (int64_t y = 0; y < H; ++y) {
+      const int64_t sy = y + dy - pad;
+      const bool row_ok = sy >= 0 && sy < H;
+      for (int64_t x = 0; x < W; ++x) {
+        const int64_t xx = flip ? (W - 1 - x) : x;
+        const int64_t sx = xx + dx - pad;
+        const bool ok = row_ok && sx >= 0 && sx < W;
+        for (int64_t c = 0; c < C; ++c) {
+          const int64_t dst = cfg_.chw ? (c * H * W + y * W + x)
+                                       : ((y * W + x) * C + c);
+          if (ok) {
+            const int64_t src = cfg_.chw ? (c * H * W + sy * W + sx)
+                                         : ((sy * W + sx) * C + c);
+            out[dst] = s[src] * (1.0f / 255.0f);
+          } else {
+            out[dst] = 0.0f;
+          }
+        }
       }
     }
   }
@@ -349,6 +409,12 @@ void* ddl_loader_create_file(const char* path, int64_t batch,
 
 int64_t ddl_loader_num_records(void* loader) {
   return static_cast<Loader*>(loader)->num_records();
+}
+
+void ddl_loader_enable_augment(void* loader, int64_t pad, int64_t img_h,
+                               int64_t img_w, int64_t channels, int chw) {
+  static_cast<Loader*>(loader)->EnableAugment(pad, img_h, img_w, channels,
+                                              chw != 0);
 }
 
 void ddl_loader_fill(void* loader, int64_t index, float* data,

@@ -95,6 +95,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.ddl_loader_num_records.restype = ctypes.c_int64
     lib.ddl_loader_num_records.argtypes = [ctypes.c_void_p]
+    lib.ddl_loader_enable_augment.restype = None
+    lib.ddl_loader_enable_augment.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int,
+    ]
     lib.ddl_loader_fill.restype = None
     lib.ddl_loader_fill.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, f32p, i32p,
@@ -272,6 +277,15 @@ class RecordFileImages:
                     self.prefetch_depth, int(self.shuffle),
                 ),
             )
+            if self.augment:
+                # Augment inside the C++ worker pool (off the consumer
+                # thread); bit-exact with data.augment_images, asserted in
+                # tests/test_native_loader.py.
+                lib.ddl_loader_enable_augment(
+                    self._h.ptr, self.aug_pad, self.image_size,
+                    self.image_size, self.channels,
+                    int(self.layout == "chw"),
+                )
         else:
             raw = np.fromfile(self.path, np.uint8)
             self._np = raw.reshape(-1, self._record)
@@ -307,12 +321,18 @@ class RecordFileImages:
         label = np.zeros((self.batch_size,), np.int32)
         for b in range(self.label_bytes):
             label |= labels[:, b] << (8 * b)
-        data = recs[:, self.label_bytes :].astype(np.float32) / 255.0
+        # Reciprocal MULTIPLY, matching loader.cc exactly (x * (1.0f/255.0f));
+        # division differs in the last ulp and would break the bit-exact
+        # native/fallback contract the tests pin.
+        data = recs[:, self.label_bytes :].astype(np.float32) * np.float32(
+            1.0 / 255.0
+        )
         return self._pack(data, label, index)
 
     def _pack(self, data, labels, index: int):
         image = _as_image(data, self.image_size, self.channels, self.layout)
-        if self.augment:
+        # Native path: the C++ workers already augmented the payload.
+        if self.augment and self._h is None:
             from ..data import augment_images
 
             image = augment_images(

@@ -18,7 +18,14 @@ done_yet() {
 
 # Separate budgets: wedge probes are cheap (2 min), measurement attempts
 # are not (up to 40 min) — a deterministically-failing config must not
-# hammer the shared chip for days.
+# hammer the shared chip for days. An attempt that makes progress (fewer
+# pending configs after than before) resets the budget, so mid-measure
+# wedges keep being ridden out across all 40 probes.
+pending_count() {
+  python tools/measure_tpu.py --check 2>/dev/null \
+    | sed -n 's/^pending: //p' | wc -w
+}
+
 measure_attempts=0
 for i in $(seq 1 40); do
   if done_yet; then
@@ -26,13 +33,18 @@ for i in $(seq 1 40); do
     exit 0
   fi
   if [ "$measure_attempts" -ge 5 ]; then
-    echo "5 measurement attempts exhausted without completing — giving up"
+    echo "5 no-progress measurement attempts exhausted — giving up"
     exit 1
   fi
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     measure_attempts=$((measure_attempts + 1))
-    echo "probe $i: chip alive — measuring (attempt $measure_attempts)"
+    before=$(pending_count)
+    echo "probe $i: chip alive — measuring (attempt $measure_attempts, $before pending)"
     timeout 2400 python tools/measure_tpu.py
+    after=$(pending_count)
+    if [ "$after" -lt "$before" ]; then
+      measure_attempts=0  # progress: keep riding out mid-measure wedges
+    fi
     sleep 60  # a persistently-failing config must not hot-loop
   else
     echo "probe $i: wedged"

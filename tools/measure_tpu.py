@@ -101,10 +101,17 @@ def main() -> int:
             print("RESULT", name, json.dumps(record), flush=True)
         except Exception as e:  # keep measuring the rest
             failed = {"error": f"{type(e).__name__}: {e}"[:500]}
-            if _is_measurement(out.get(name)):
-                # A stale-but-real prior measurement beats nothing: keep it
-                # alongside the error instead of destroying it.
-                failed["previous"] = out[name]
+            # A stale-but-real prior measurement beats nothing: keep it
+            # alongside the error — including across REPEATED failures
+            # (carry the previous record forward, don't drop it on the
+            # second consecutive error).
+            prior = out.get(name)
+            if _is_measurement(prior):
+                failed["previous"] = prior
+            elif isinstance(prior, dict) and _is_measurement(
+                prior.get("previous")
+            ):
+                failed["previous"] = prior["previous"]
             out[name] = failed
             print("RESULT", name, "FAILED", failed["error"], flush=True)
         tmp = _OUT_PATH + ".tmp"
