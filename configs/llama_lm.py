@@ -1,0 +1,45 @@
+"""Llama-300M causal LM — the modern-decoder / long-context workload.
+
+Beyond the reference's workload list (``BASELINE.json:6-12``): exercises
+RoPE + RMSNorm + SwiGLU + grouped-query attention through the same mesh,
+kernel, and trainer machinery as the GPT-2 workload (``models/llama.py``,
+golden-tested against ``transformers.LlamaForCausalLM``).
+
+Long sequences: ``--override model.kwargs.attn_impl=ring --override
+mesh.cp=4`` shards the sequence over the cp ring (the mesh is injected by
+``cli.build_all``); ``'flash'`` (default) runs the fused kernel per chip.
+"""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(
+            name="llama",
+            kwargs={
+                "size": "300m",
+                "max_len": 2048,
+                "attn_impl": "flash",
+                "chunked_head": True,
+                "dtype": "bfloat16",
+            },
+        ),
+        data=DataConfig(
+            kind="synthetic_tokens", batch_size=16, seq_len=2048,
+            vocab_size=32000,
+        ),
+        optim=OptimConfig(
+            name="adamw_fused", lr=3e-4, b2=0.95, weight_decay=0.1,
+            schedule="cosine", warmup_steps=200, grad_clip=1.0,
+        ),
+        train=TrainConfig(steps=1000, log_every=20, task="lm", zero1=True),
+        mesh=MeshConfig(dp=-1),
+    )

@@ -31,4 +31,4 @@ def available() -> list[str]:
     return sorted(_REGISTRY)
 
 
-from . import bert, gpt2, moe, pipeline, resnet, vit  # noqa: E402,F401
+from . import bert, gpt2, llama, moe, pipeline, resnet, vit  # noqa: E402,F401

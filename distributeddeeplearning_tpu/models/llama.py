@@ -1,0 +1,286 @@
+"""Llama-family decoder — RoPE, RMSNorm, SwiGLU, grouped-query attention.
+
+Not a reference workload (``BASELINE.json:6-12`` stops at GPT-2-era
+architectures); included because the framework's parallelism and kernel
+layers should carry a modern decoder unchanged, and because GQA + RoPE is
+the architecture the long-context machinery (ring attention over ``cp``)
+actually gets used with in practice. Numerics are pinned against
+``transformers.LlamaForCausalLM`` (weight-ported golden test, fp32).
+
+TPU-first details, consistent with the rest of the zoo:
+- projections carry the same logical axes as ``transformer.SelfAttention``
+  (``('embed','heads','kv')``, MLP ``('embed','mlp')``), so Megatron TP is
+  the same rules table — no new sharding code. GQA shards KV heads over
+  ``tp`` too (an indivisible ``num_kv_heads % tp`` draws a loud
+  RuntimeWarning from the ``sharding`` validator — XLA pads rather than
+  fails, so it warns, not raises);
+- RoPE tables are computed in fp32 and applied pre-repeat, so the KV cache
+  dtype never touches position math;
+- ``attn_impl`` ∈ {xla, flash, ring, ring_pallas}: the fused flash kernel
+  and the ring context-parallel cores take the GQA-repeated q/k/v exactly
+  like MHA — repeat-then-core is the standard GQA lowering;
+- RMSNorm reduces in fp32 regardless of compute dtype;
+- ``chunked_head=True`` returns hidden + the (untied) lm_head matrix for
+  the chunked cross-entropy (``ops/chunked_xent.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from . import register
+from ..sharding import constrain
+from .transformer import dense_init
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            (x.shape[-1],),
+        )
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (normed * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+def rope_tables(positions, head_dim: int, theta: float):
+    """fp32 (cos, sin) tables, [L, head_dim//2] — HF Llama's layout
+    (``inv_freq = theta ** -(arange(0, d, 2) / d)``)."""
+    half = head_dim // 2
+    inv_freq = theta ** -(np.arange(0, half, dtype=np.float32) * 2 / head_dim)
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate-half RoPE on [B, L, H, D] (HF formulation: the two halves of
+    the head dim rotate against each other)."""
+    half = x.shape[-1] // 2
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    ).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"  # xla | flash | ring | ring_pallas
+    mesh: object = None  # required for the ring variants
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, E = x.shape
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_heads {self.num_heads} not a multiple of "
+                f"num_kv_heads {self.num_kv_heads}"
+            )
+
+        def proj(name, heads):
+            return nn.DenseGeneral(
+                features=(heads, self.head_dim),
+                use_bias=False,  # Llama projections are bias-free
+                dtype=self.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    dense_init(0.02), ("embed", "heads", "kv")
+                ),
+                name=name,
+            )
+
+        q = proj("query", self.num_heads)(x)
+        k = proj("key", self.num_kv_heads)(x)
+        v = proj("value", self.num_kv_heads)(x)
+
+        cos, sin = rope_tables(jnp.arange(L), self.head_dim, self.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        # GQA: repeat KV groups up to the query head count, then run any
+        # MHA core. HF orders repeats group-major (head g*r+i reads kv g).
+        rep = self.num_heads // self.num_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        if self.attn_impl == "flash":
+            from ..ops import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        elif self.attn_impl in ("ring", "ring_pallas"):
+            if self.mesh is None:
+                raise ValueError(f"{self.attn_impl!r} requires mesh")
+            from ..parallel.sp_ring import ring_attention_fn
+
+            out = ring_attention_fn(self.attn_impl)(
+                q, k, v, self.mesh, causal=True
+            )
+        elif self.attn_impl == "xla":
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            scores = scores / np.sqrt(self.head_dim)
+            causal = jnp.tril(jnp.ones((L, L), bool))
+            scores = jnp.where(causal[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        else:
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+
+        return nn.DenseGeneral(
+            features=E,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                dense_init(0.02), ("heads", "kv", "embed")
+            ),
+            name="out",
+        )(out)
+
+
+class LlamaMlp(nn.Module):
+    """SwiGLU: down(silu(gate(x)) * up(x)); column-parallel gate/up, row-
+    parallel down — the same TP split as the GELU MLP."""
+
+    hidden_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        def col(name):
+            return nn.Dense(
+                self.hidden_dim, use_bias=False, dtype=self.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    dense_init(0.02), ("embed", "mlp")
+                ),
+                name=name,
+            )
+
+        h = nn.silu(col("gate")(x)) * col("up")(x)
+        return nn.Dense(
+            x.shape[-1], use_bias=False, dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                dense_init(0.02), ("mlp", "embed")
+            ),
+            name="down",
+        )(h)
+
+
+class LlamaBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    mlp_dim: int
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + LlamaAttention(
+            self.num_heads, self.num_kv_heads, self.head_dim,
+            rope_theta=self.rope_theta, dtype=self.dtype,
+            attn_impl=self.attn_impl, mesh=self.mesh, name="attn",
+        )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
+        x = constrain(x, "batch", "seq", "embed")
+        x = x + LlamaMlp(self.mlp_dim, self.dtype, name="mlp")(
+            RMSNorm(self.rms_eps, self.dtype, name="mlp_norm")(x)
+        )
+        return constrain(x, "batch", "seq", "embed")
+
+
+class Llama(nn.Module):
+    vocab_size: int = 32000
+    max_len: int = 4096
+    num_layers: int = 8
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    embed_dim: int = 512
+    mlp_dim: int = 1408
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    remat: str = "none"
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+    mesh: object = None
+    chunked_head: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, L = tokens.shape
+        if L > self.max_len:
+            raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )(tokens)
+        x = constrain(x, "batch", "seq", "embed")
+        block = LlamaBlock
+        if self.remat == "full":
+            block = nn.remat(LlamaBlock)
+        elif self.remat != "none":
+            raise ValueError(f"unknown remat {self.remat!r}")
+        for i in range(self.num_layers):
+            x = block(
+                self.num_heads, self.num_kv_heads,
+                self.embed_dim // self.num_heads, self.mlp_dim,
+                rope_theta=self.rope_theta, rms_eps=self.rms_eps,
+                dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
+                name=f"block_{i}",
+            )(x)
+        x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
+        # Untied LM head as an explicit param so both head modes share one
+        # param tree (checkpoints/parity stay mode-independent).
+        kernel = self.param(
+            "lm_head",
+            nn.with_logical_partitioning(
+                dense_init(0.02), ("embed", "vocab")
+            ),
+            (self.embed_dim, self.vocab_size),
+        )
+        kernel = jnp.asarray(kernel, self.dtype)
+        if self.chunked_head:
+            from ..ops.chunked_xent import head_output
+
+            # chunked_xent wants the decoder as [V, E].
+            return head_output(x, kernel.T)
+        return jnp.einsum("ble,ev->blv", x, kernel).astype(jnp.float32)
+
+
+@register("llama")
+def llama(size: str = "tiny", **kwargs):
+    sizes = {
+        # (layers, heads, kv_heads, embed, mlp)
+        "tiny": (2, 4, 2, 64, 128),
+        "300m": (12, 16, 8, 1024, 2816),
+        "1b": (16, 32, 8, 2048, 5632),
+        "7b": (32, 32, 32, 4096, 11008),
+    }
+    n_l, n_h, n_kv, d, m = sizes[size]
+    defaults = dict(
+        num_layers=n_l, num_heads=n_h, num_kv_heads=n_kv,
+        embed_dim=d, mlp_dim=m,
+    )
+    defaults.update(kwargs)
+    return Llama(**defaults)

@@ -182,3 +182,42 @@ def convert_vit(hf_model, n_layers, n_heads, head_dim):
             },
         }
     return p
+
+
+def convert_llama(hf_model, n_layers, n_heads, n_kv_heads, head_dim):
+    """transformers LlamaForCausalLM -> models/llama.py param tree."""
+    sd = {k: t2n(v) for k, v in hf_model.state_dict().items()}
+
+    def heads(key, n):
+        w = sd[f"{key}.weight"].T  # [embed, n*head_dim]
+        return {"kernel": w.reshape(w.shape[0], n, head_dim)}
+
+    p = {
+        "embed": {"embedding": sd["model.embed_tokens.weight"]},
+        "norm": {"scale": sd["model.norm.weight"]},
+        "lm_head": sd["lm_head.weight"].T,
+    }
+    for i in range(n_layers):
+        pre = f"model.layers.{i}"
+        p[f"block_{i}"] = {
+            "attn_norm": {"scale": sd[f"{pre}.input_layernorm.weight"]},
+            "mlp_norm": {
+                "scale": sd[f"{pre}.post_attention_layernorm.weight"]
+            },
+            "attn": {
+                "query": heads(f"{pre}.self_attn.q_proj", n_heads),
+                "key": heads(f"{pre}.self_attn.k_proj", n_kv_heads),
+                "value": heads(f"{pre}.self_attn.v_proj", n_kv_heads),
+                "out": {
+                    "kernel": (lambda w: w.reshape(
+                        n_heads, head_dim, w.shape[-1]
+                    ))(sd[f"{pre}.self_attn.o_proj.weight"].T)
+                },
+            },
+            "mlp": {
+                "gate": {"kernel": sd[f"{pre}.mlp.gate_proj.weight"].T},
+                "up": {"kernel": sd[f"{pre}.mlp.up_proj.weight"].T},
+                "down": {"kernel": sd[f"{pre}.mlp.down_proj.weight"].T},
+            },
+        }
+    return p

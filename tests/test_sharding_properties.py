@@ -72,6 +72,13 @@ ZOO = {
         example=lambda: jnp.zeros((4, 16), jnp.int32),
         heads=4, mlp=256, embed=64, vocab=256, experts=8,
     ),
+    "llama": dict(
+        kwargs=dict(size="tiny", vocab_size=256, max_len=64),
+        example=lambda: jnp.zeros((4, 16), jnp.int32),
+        # GQA: the KV projections' 'heads' dim is num_kv_heads (2), the
+        # binding constraint for tp divisibility.
+        heads=4, mlp=128, embed=64, vocab=256, kv_heads=2,
+    ),
 }
 
 _SPEC_CACHE: dict[str, object] = {}
@@ -97,6 +104,8 @@ def _mesh_fits(name, sizes):
     ):
         return False
     if zoo["vocab"] % d["tp"]:
+        return False
+    if zoo.get("kv_heads") is not None and zoo["kv_heads"] % d["tp"]:
         return False
     if zoo["embed"] % d["fsdp"]:
         return False
