@@ -33,7 +33,7 @@ from flax import linen as nn
 
 from . import register
 from ..sharding import constrain
-from .transformer import dense_init
+from .transformer import attention_core, dense_init
 
 
 class RMSNorm(nn.Module):
@@ -120,27 +120,10 @@ class LlamaAttention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        if self.attn_impl == "flash":
-            from ..ops import flash_attention
-
-            out = flash_attention(q, k, v, causal=True)
-        elif self.attn_impl in ("ring", "ring_pallas"):
-            if self.mesh is None:
-                raise ValueError(f"{self.attn_impl!r} requires mesh")
-            from ..parallel.sp_ring import ring_attention_fn
-
-            out = ring_attention_fn(self.attn_impl)(
-                q, k, v, self.mesh, causal=True
-            )
-        elif self.attn_impl == "xla":
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-            scores = scores / np.sqrt(self.head_dim)
-            causal = jnp.tril(jnp.ones((L, L), bool))
-            scores = jnp.where(causal[None, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-        else:
-            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+        out = attention_core(
+            q, k, v, impl=self.attn_impl, causal=True, dtype=self.dtype,
+            mesh=self.mesh,
+        )
 
         return nn.DenseGeneral(
             features=E,

@@ -40,6 +40,63 @@ def dense_init(scale: float = 0.02):
     return nn.initializers.normal(stddev=scale)
 
 
+def attention_core(
+    q,
+    k,
+    v,
+    *,
+    impl: str,
+    causal: bool,
+    dtype,
+    mesh=None,
+    mask=None,
+    kv_valid=None,
+    head_axes=None,
+    dropout=None,
+):
+    """Post-projection attention dispatch — the ONE place the xla, fused
+    flash, and ring cores are selected (shared by ``SelfAttention`` and
+    ``models/llama.LlamaAttention``, so a core numerics fix lands once).
+
+    q/k/v: [batch, seq, heads, head_dim] with equal head counts (GQA is
+    repeated to MHA by the caller). ``mask``/``dropout`` apply to the xla
+    core only (callers gate the other cores loudly); ``kv_valid`` and
+    ``head_axes`` are flash-kernel options; ``mesh`` is required by the
+    ring cores.
+    """
+    if impl == "flash":
+        from ..ops import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, kv_valid_lens=kv_valid,
+            **({"head_axes": head_axes} if head_axes else {}),
+        )
+    if impl in ("ring", "ring_pallas"):
+        if mesh is None:
+            raise ValueError(f"attn_impl={impl!r} requires mesh")
+        from ..parallel.sp_ring import ring_attention_fn
+
+        return ring_attention_fn(impl)(q, k, v, mesh, causal=causal)
+    if impl != "xla":
+        raise ValueError(f"unknown attn_impl {impl!r}")
+    head_dim = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / np.sqrt(head_dim)
+    if causal:
+        q_len, k_len = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((q_len, k_len), bool))
+        scores = jnp.where(causal_mask[None, None], scores, -1e30)
+    if mask is not None:
+        # mask: [batch, k_len] (1 = attend) or broadcastable.
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        scores = jnp.where(mask.astype(bool), scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    if dropout is not None:
+        probs = dropout(probs)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 class SelfAttention(nn.Module):
     """Multi-head self-attention with logical-axis-annotated projections.
 
@@ -117,10 +174,9 @@ class SelfAttention(nn.Module):
                 kv_valid = mask.astype(jnp.int32).sum(-1)
                 prefix = jnp.arange(mask.shape[-1])[None, :] < kv_valid[:, None]
                 not_prefix = (mask.astype(bool) != prefix).any(-1)
-            from ..ops import flash_attention
-
-            out = flash_attention(
-                q, k, v, causal=self.causal, kv_valid_lens=kv_valid
+            out = attention_core(
+                q, k, v, impl="flash", causal=self.causal,
+                dtype=self.dtype, kv_valid=kv_valid,
             )
             if not_prefix is not None:
                 out = jnp.where(
@@ -132,14 +188,9 @@ class SelfAttention(nn.Module):
                     "ring attention supports mask=None and no active "
                     "attention-dropout"
                 )
-            if self.mesh is None:
-                raise ValueError(
-                    f"attn_impl={self.attn_impl!r} requires mesh"
-                )
-            from ..parallel.sp_ring import ring_attention_fn
-
-            out = ring_attention_fn(self.attn_impl)(
-                q, k, v, self.mesh, causal=self.causal
+            out = attention_core(
+                q, k, v, impl=self.attn_impl, causal=self.causal,
+                dtype=self.dtype, mesh=self.mesh,
             )
         else:
             if self.attn_impl in ("ulysses", "ulysses_flash"):
@@ -167,33 +218,19 @@ class SelfAttention(nn.Module):
                         "ulysses_flash supports mask=None and no active "
                         "attention-dropout"
                     )
-                from ..ops import flash_attention
-
                 # Interior layout: seq gathered, heads over (tp, cp).
-                out = flash_attention(
-                    q, k, v, causal=self.causal, head_axes=("tp", "cp")
+                out = attention_core(
+                    q, k, v, impl="flash", causal=self.causal,
+                    dtype=self.dtype, head_axes=("tp", "cp"),
                 )
             else:
-                scores = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q, k
-                ).astype(jnp.float32)
-                scores = scores / np.sqrt(self.head_dim)
-                if self.causal:
-                    q_len, k_len = scores.shape[-2], scores.shape[-1]
-                    causal_mask = jnp.tril(jnp.ones((q_len, k_len), bool))
-                    scores = jnp.where(
-                        causal_mask[None, None], scores, -1e30
-                    )
-                if mask is not None:
-                    # mask: [batch, k_len] (1 = attend) or broadcastable.
-                    if mask.ndim == 2:
-                        mask = mask[:, None, None, :]
-                    scores = jnp.where(mask.astype(bool), scores, -1e30)
-                probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-                probs = nn.Dropout(
-                    self.dropout_rate, deterministic=deterministic
-                )(probs)
-                out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+                out = attention_core(
+                    q, k, v, impl="xla", causal=self.causal,
+                    dtype=self.dtype, mask=mask,
+                    dropout=nn.Dropout(
+                        self.dropout_rate, deterministic=deterministic
+                    ),
+                )
             if self.attn_impl in ("ulysses", "ulysses_flash"):
                 from ..parallel.sp_ulysses import ulysses_restore
 

@@ -86,10 +86,12 @@ def test_flash_core_matches_xla(mesh1):
     np.testing.assert_allclose(flash, xla, rtol=2e-4)
 
 
-def test_ring_attention_on_cp_mesh_matches_single_device(mesh1):
-    # Long-context path: seq sharded over cp=4, KV rotated by ppermute.
+@pytest.mark.parametrize("impl", ["ring", "ring_pallas"])
+def test_ring_attention_on_cp_mesh_matches_single_device(mesh1, impl):
+    # Long-context path: seq sharded over cp=4, KV rotated by ppermute
+    # (ring_pallas: the fused per-visit kernel, GQA-repeated heads).
     single = _losses(mesh1)
-    ring = _losses(mesh_of(dp=2, cp=4), attn_impl="ring")
+    ring = _losses(mesh_of(dp=2, cp=4), attn_impl=impl)
     np.testing.assert_allclose(ring, single, rtol=2e-4)
 
 

@@ -82,3 +82,30 @@ assert err < 1e-5, err
 print("ADAMW_TPU_OK")
 """)
     assert "ADAMW_TPU_OK" in out
+
+
+def test_llama_train_step_on_tpu():
+    # Modern-decoder path on the real chip: RoPE + GQA + SwiGLU through
+    # the flash kernel and chunked head, one real train step, finite loss.
+    out = run_on_tpu("""
+import jax, jax.numpy as jnp
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.data import SyntheticTokens, sharded_batches
+from distributeddeeplearning_tpu.mesh import single_device_mesh
+from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
+assert jax.default_backend() == "tpu", jax.default_backend()
+mesh = single_device_mesh()
+model = models.get_model(
+    "llama", size="tiny", vocab_size=256, max_len=128,
+    attn_impl="flash", chunked_head=True, dtype=jnp.bfloat16)
+trainer = Trainer(model, make_optimizer("adamw", 1e-3),
+                  get_task("lm", head_chunk=64), mesh, donate=False)
+ds = SyntheticTokens(batch_size=8, seq_len=128, vocab_size=256)
+state = trainer.init(0, ds.batch(0))
+batch = next(iter(sharded_batches(ds.iter_from(0), mesh)))
+state, m = trainer.train_step(state, batch)
+loss = float(m["loss"])
+assert loss == loss and loss < 20, loss
+print("LLAMA_TPU_OK", loss)
+""")
+    assert "LLAMA_TPU_OK" in out

@@ -24,6 +24,8 @@ RUNS = [
     ("bert_mlm", [], 5, 20),
     ("gpt2_owt", [], 3, 10),
     ("vit_imagenet21k", [], 3, 10),
+    # Beyond the reference's workload list: the modern-decoder config.
+    ("llama_lm", [], 3, 10),
 ]
 
 _OUT_PATH = os.path.join(_REPO, "TPU_NUMBERS.json")
