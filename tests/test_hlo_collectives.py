@@ -25,6 +25,8 @@ def compiled_step_text(mesh, model_name="gpt2", attn_impl="xla", rules=None,
     """Compile the full train step (never a toy function — the round-2
     no-ops were invisible precisely because only toys were inspected)."""
     kwargs = dict(size="tiny", vocab_size=64, max_len=32, dropout_rate=0.0)
+    if model_name == "llama":
+        del kwargs["dropout_rate"]  # the Llama module has no dropout knob
     if model_name == "gpt2":
         kwargs["attn_impl"] = attn_impl
         kwargs["mesh"] = mesh if attn_impl in ("ring", "ring_pallas") else None
@@ -129,3 +131,16 @@ def test_constrain_applies_inside_meshed_step():
     assert isinstance(y.sharding, NamedSharding)
     assert y.addressable_shards[0].data.shape[0] == 2
     np.testing.assert_allclose(np.asarray(y), 1.0)
+
+
+def test_llama_tp_emits_boundary_reductions():
+    # The Llama blocks reuse the same logical axes, so Megatron TP must
+    # emit its boundary all-reduces for them exactly as for GPT-2 — and a
+    # dp-only compile on the same device count must not.
+    dp_only = collective_counts(
+        compiled_step_text(mesh_of(dp=8), model_name="llama")
+    )
+    tp = collective_counts(
+        compiled_step_text(mesh_of(dp=4, tp=2), model_name="llama")
+    )
+    assert tp["all-reduce"] > dp_only["all-reduce"], (tp, dp_only)
