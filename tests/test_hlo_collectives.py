@@ -10,6 +10,7 @@ fails if (and only if) the strategy's constraints are deleted.
 """
 
 import numpy as np
+import pytest
 
 from distributeddeeplearning_tpu import data as data_lib
 from distributeddeeplearning_tpu import models
@@ -72,11 +73,17 @@ def test_megatron_sp_emits_seq_regather():
     assert sp["all-gather"] > 0, sp
 
 
-def test_tp_emits_boundary_reductions():
+@pytest.mark.parametrize("model_name", ["gpt2", "llama"])
+def test_tp_emits_boundary_reductions(model_name):
     # TP's block-boundary psums come on top of the dp gradient all-reduces:
-    # same model on a pure-dp mesh is the control.
-    tp = collective_counts(compiled_step_text(mesh_of(dp=4, tp=2)))
-    dp = collective_counts(compiled_step_text(mesh_of(dp=8)))
+    # same model on a pure-dp mesh is the control. Llama reuses the same
+    # logical axes, so the assertion covers both architectures.
+    tp = collective_counts(
+        compiled_step_text(mesh_of(dp=4, tp=2), model_name=model_name)
+    )
+    dp = collective_counts(
+        compiled_step_text(mesh_of(dp=8), model_name=model_name)
+    )
     assert tp["all-reduce"] > dp["all-reduce"], (tp, dp)
 
 
@@ -132,15 +139,3 @@ def test_constrain_applies_inside_meshed_step():
     assert y.addressable_shards[0].data.shape[0] == 2
     np.testing.assert_allclose(np.asarray(y), 1.0)
 
-
-def test_llama_tp_emits_boundary_reductions():
-    # The Llama blocks reuse the same logical axes, so Megatron TP must
-    # emit its boundary all-reduces for them exactly as for GPT-2 — and a
-    # dp-only compile on the same device count must not.
-    dp_only = collective_counts(
-        compiled_step_text(mesh_of(dp=8), model_name="llama")
-    )
-    tp = collective_counts(
-        compiled_step_text(mesh_of(dp=4, tp=2), model_name="llama")
-    )
-    assert tp["all-reduce"] > dp_only["all-reduce"], (tp, dp_only)

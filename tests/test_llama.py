@@ -131,3 +131,19 @@ def test_gqa_equals_mha_with_repeated_kv_projections():
     np.testing.assert_allclose(
         np.asarray(out_gqa), np.asarray(out_mha), atol=1e-5, rtol=1e-5
     )
+
+
+def test_port_llama_refuses_unrepresentable_checkpoints():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from distributeddeeplearning_tpu.hf_port import port_llama
+
+    base = dict(
+        vocab_size=64, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=False,
+    )
+    with pytest.raises(ValueError, match="attention_bias"):
+        port_llama(LlamaForCausalLM(LlamaConfig(**base, attention_bias=True)))
+    with pytest.raises(ValueError, match="head_dim"):
+        port_llama(LlamaForCausalLM(LlamaConfig(**base, head_dim=8)))
