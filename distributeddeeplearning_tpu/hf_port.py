@@ -220,6 +220,11 @@ def port_llama(hf_model):
             "attention_bias=True checkpoints are not portable: "
             "models/llama.py projections are bias-free"
         )
+    if getattr(cfg, "mlp_bias", False):
+        raise ValueError(
+            "mlp_bias=True checkpoints are not portable: "
+            "models/llama.py MLP projections are bias-free"
+        )
     cfg_head_dim = getattr(cfg, "head_dim", None)
     if cfg_head_dim is not None and cfg_head_dim != head_dim:
         raise ValueError(

@@ -84,6 +84,11 @@ class LlamaAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "xla"  # xla | flash | ring | ring_pallas
     mesh: object = None  # required for the ring variants
+    # Manual tensor parallelism (inside an explicit shard_map, e.g. PP×TP):
+    # the module then sees tp-LOCAL head counts and psums the row-parallel
+    # out-projection over this axis (projections are bias-free, so no
+    # bias pre-scaling is needed — cf. transformer.SelfAttention).
+    psum_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -125,7 +130,7 @@ class LlamaAttention(nn.Module):
             mesh=self.mesh,
         )
 
-        return nn.DenseGeneral(
+        out = nn.DenseGeneral(
             features=E,
             axis=(-2, -1),
             use_bias=False,
@@ -135,6 +140,9 @@ class LlamaAttention(nn.Module):
             ),
             name="out",
         )(out)
+        if self.psum_axis is not None:
+            out = jax.lax.psum(out, self.psum_axis)
+        return out
 
 
 class LlamaMlp(nn.Module):
@@ -143,6 +151,7 @@ class LlamaMlp(nn.Module):
 
     hidden_dim: int
     dtype: jnp.dtype = jnp.float32
+    psum_axis: str | None = None  # manual TP (see LlamaAttention)
 
     @nn.compact
     def __call__(self, x):
@@ -156,13 +165,16 @@ class LlamaMlp(nn.Module):
             )
 
         h = nn.silu(col("gate")(x)) * col("up")(x)
-        return nn.Dense(
+        out = nn.Dense(
             x.shape[-1], use_bias=False, dtype=self.dtype,
             kernel_init=nn.with_logical_partitioning(
                 dense_init(0.02), ("mlp", "embed")
             ),
             name="down",
         )(h)
+        if self.psum_axis is not None:
+            out = jax.lax.psum(out, self.psum_axis)
+        return out
 
 
 class LlamaBlock(nn.Module):
@@ -175,19 +187,25 @@ class LlamaBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "xla"
     mesh: object = None
+    psum_axis: str | None = None  # manual TP inside shard_map (PP×TP)
+    # False inside pipeline stages: the body runs under shard_map on
+    # per-device arrays, where global sharding constraints don't apply.
+    constrain_out: bool = True
 
     @nn.compact
     def __call__(self, x):
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.head_dim,
             rope_theta=self.rope_theta, dtype=self.dtype,
-            attn_impl=self.attn_impl, mesh=self.mesh, name="attn",
+            attn_impl=self.attn_impl, mesh=self.mesh,
+            psum_axis=self.psum_axis, name="attn",
         )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
-        x = constrain(x, "batch", "seq", "embed")
-        x = x + LlamaMlp(self.mlp_dim, self.dtype, name="mlp")(
-            RMSNorm(self.rms_eps, self.dtype, name="mlp_norm")(x)
-        )
-        return constrain(x, "batch", "seq", "embed")
+        if self.constrain_out:
+            x = constrain(x, "batch", "seq", "embed")
+        x = x + LlamaMlp(
+            self.mlp_dim, self.dtype, psum_axis=self.psum_axis, name="mlp"
+        )(RMSNorm(self.rms_eps, self.dtype, name="mlp_norm")(x))
+        return constrain(x, "batch", "seq", "embed") if self.constrain_out else x
 
 
 class Llama(nn.Module):

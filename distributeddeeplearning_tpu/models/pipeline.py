@@ -38,7 +38,12 @@ class PipelineStage(nn.Module):
     body runs inside shard_map where global sharding constraints don't
     apply). ``psum_axis`` enables manual TP inside the stage (PP×TP): the
     module is then constructed with tp-LOCAL head/mlp counts and the blocks
-    psum their row-parallel outputs over that axis."""
+    psum their row-parallel outputs over that axis.
+
+    ``block_kind`` selects the architecture: 'gpt2' = the shared
+    ``TransformerBlock`` (GPT-2/BERT/ViT family), 'llama' = ``LlamaBlock``
+    (RoPE + GQA + SwiGLU; ``num_kv_heads`` then applies, tp-local like
+    ``num_heads``)."""
 
     num_layers: int
     num_heads: int
@@ -51,24 +56,43 @@ class PipelineStage(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     psum_axis: str | None = None
+    block_kind: str = "gpt2"  # gpt2 | llama
+    num_kv_heads: int = 0  # llama only
+    rope_theta: float = 10000.0  # llama only
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
+        from .llama import LlamaBlock  # function-local: avoids an import cycle
+
         for i in range(self.num_layers):
-            x = TransformerBlock(
-                self.num_heads,
-                self.head_dim,
-                self.mlp_dim,
-                pre_ln=self.pre_ln,
-                causal=self.causal,
-                activation=self.activation,
-                ln_eps=self.ln_eps,
-                dropout_rate=self.dropout_rate,
-                dtype=self.dtype,
-                constrain_out=False,
-                psum_axis=self.psum_axis,
-                name=f"block_{i}",
-            )(x, None, deterministic)
+            if self.block_kind == "llama":
+                x = LlamaBlock(
+                    self.num_heads,
+                    self.num_kv_heads,
+                    self.head_dim,
+                    self.mlp_dim,
+                    rope_theta=self.rope_theta,
+                    rms_eps=self.ln_eps,
+                    dtype=self.dtype,
+                    psum_axis=self.psum_axis,
+                    constrain_out=False,
+                    name=f"block_{i}",
+                )(x)
+            else:
+                x = TransformerBlock(
+                    self.num_heads,
+                    self.head_dim,
+                    self.mlp_dim,
+                    pre_ln=self.pre_ln,
+                    causal=self.causal,
+                    activation=self.activation,
+                    ln_eps=self.ln_eps,
+                    dropout_rate=self.dropout_rate,
+                    dtype=self.dtype,
+                    constrain_out=False,
+                    psum_axis=self.psum_axis,
+                    name=f"block_{i}",
+                )(x, None, deterministic)
         return x
 
 
@@ -95,6 +119,9 @@ class PipelinedTransformerStack(nn.Module):
     pipeline: bool = True
     schedule: str = "gpipe"  # gpipe | 1f1b (see parallel/pp.py)
     mesh: object = None  # jax.sharding.Mesh, required when pipelining
+    block_kind: str = "gpt2"  # gpt2 | llama (see PipelineStage)
+    num_kv_heads: int = 0  # llama only
+    rope_theta: float = 10000.0  # llama only
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -131,6 +158,11 @@ class PipelinedTransformerStack(nn.Module):
                     f"pp×tp: num_heads={self.num_heads} and "
                     f"mlp_dim={self.mlp_dim} must be divisible by tp={tp}"
                 )
+            if self.block_kind == "llama" and self.num_kv_heads % tp:
+                raise ValueError(
+                    f"pp×tp: num_kv_heads={self.num_kv_heads} must be "
+                    f"divisible by tp={tp}"
+                )
         stage_kw = dict(
             pre_ln=self.pre_ln,
             causal=self.causal,
@@ -138,6 +170,8 @@ class PipelinedTransformerStack(nn.Module):
             ln_eps=self.ln_eps,
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
+            block_kind=self.block_kind,
+            rope_theta=self.rope_theta,
         )
         # Init always uses the GLOBAL module (full head/mlp counts): stored
         # parameters are the full weights; the tp slicing happens at the
@@ -147,6 +181,7 @@ class PipelinedTransformerStack(nn.Module):
             self.num_heads,
             self.head_dim,
             self.mlp_dim,
+            num_kv_heads=self.num_kv_heads,
             **stage_kw,
         )
         stage_mod_body = (
@@ -155,6 +190,7 @@ class PipelinedTransformerStack(nn.Module):
                 self.num_heads // tp,
                 self.head_dim,
                 self.mlp_dim // tp,
+                num_kv_heads=self.num_kv_heads // tp,
                 psum_axis="tp",
                 **stage_kw,
             )
@@ -382,6 +418,100 @@ class PipelinedGPT2(nn.Module):
             "h": {"stages": dstacked},
         }
         return loss, grads
+
+
+class PipelinedLlama(nn.Module):
+    """Llama with a pipelined block stack (GPipe / 1F1B over ``pp``; PP×TP
+    inside stages) — same stage machinery as :class:`PipelinedGPT2`, Llama
+    blocks and head (``models/llama.py``). The interleaved schedule's
+    grads-inside engine is GPT-2-only; use ``schedule='1f1b'`` here."""
+
+    vocab_size: int = 32000
+    max_len: int = 4096
+    num_layers: int = 8
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    embed_dim: int = 512
+    mlp_dim: int = 1408
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    num_stages: int = 2
+    num_microbatches: int = 2
+    pipeline: bool = True
+    schedule: str = "gpipe"  # gpipe | 1f1b
+    dtype: jnp.dtype = jnp.float32
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        from .llama import RMSNorm
+        from .transformer import dense_init
+
+        if self.schedule == "1f1b_interleaved":
+            raise NotImplementedError(
+                "schedule='1f1b_interleaved' is wired for gpt2_pp only; "
+                "use 'gpipe' or '1f1b' with llama_pp"
+            )
+        B, L = tokens.shape
+        if L > self.max_len:
+            raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
+        x = nn.Embed(
+            self.vocab_size,
+            self.embed_dim,
+            dtype=self.dtype,
+            # vocab over (tp, pp): no per-pp-rank embedding replication.
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab_pp", "embed")
+            ),
+            name="embed",
+        )(tokens)
+        x = constrain(x, "batch", "seq", "embed")
+        x = PipelinedTransformerStack(
+            num_layers=self.num_layers,
+            num_stages=self.num_stages,
+            num_microbatches=self.num_microbatches,
+            num_heads=self.num_heads,
+            head_dim=self.embed_dim // self.num_heads,
+            mlp_dim=self.mlp_dim,
+            ln_eps=self.rms_eps,
+            dtype=self.dtype,
+            pipeline=self.pipeline,
+            schedule=self.schedule,
+            mesh=self.mesh,
+            block_kind="llama",
+            num_kv_heads=self.num_kv_heads,
+            rope_theta=self.rope_theta,
+            name="h",
+        )(x, None, not train)
+        x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
+        kernel = self.param(
+            "lm_head",
+            nn.with_logical_partitioning(
+                dense_init(0.02), ("embed", "vocab_pp")
+            ),
+            (self.embed_dim, self.vocab_size),
+        )
+        logits = jnp.einsum(
+            "ble,ev->blv", x, jnp.asarray(kernel, self.dtype)
+        )
+        return logits.astype(jnp.float32)
+
+
+@register("llama_pp")
+def llama_pp(size: str = "tiny", **kwargs):
+    sizes = {
+        # (layers, heads, kv_heads, embed, mlp)
+        "tiny": (4, 4, 2, 64, 128),
+        "300m": (12, 16, 8, 1024, 2816),
+        "1b": (16, 32, 8, 2048, 5632),
+    }
+    n_l, n_h, n_kv, d, m = sizes[size]
+    defaults = dict(
+        num_layers=n_l, num_heads=n_h, num_kv_heads=n_kv,
+        embed_dim=d, mlp_dim=m,
+    )
+    defaults.update(kwargs)
+    return PipelinedLlama(**defaults)
 
 
 @register("gpt2_pp")

@@ -147,3 +147,18 @@ def test_port_llama_refuses_unrepresentable_checkpoints():
         port_llama(LlamaForCausalLM(LlamaConfig(**base, attention_bias=True)))
     with pytest.raises(ValueError, match="head_dim"):
         port_llama(LlamaForCausalLM(LlamaConfig(**base, head_dim=8)))
+
+
+def test_port_llama_refuses_mlp_bias():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from distributeddeeplearning_tpu.hf_port import port_llama
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=False,
+        mlp_bias=True,
+    )
+    with pytest.raises(ValueError, match="mlp_bias"):
+        port_llama(LlamaForCausalLM(cfg))
