@@ -421,10 +421,10 @@ class PipelinedGPT2(nn.Module):
 
 
 class PipelinedLlama(nn.Module):
-    """Llama with a pipelined block stack (GPipe / 1F1B over ``pp``; PP×TP
-    inside stages) — same stage machinery as :class:`PipelinedGPT2`, Llama
-    blocks and head (``models/llama.py``). The interleaved schedule's
-    grads-inside engine is GPT-2-only; use ``schedule='1f1b'`` here."""
+    """Llama with a pipelined block stack — same stage machinery as
+    :class:`PipelinedGPT2` (GPipe / 1F1B / interleaved 1F1B over ``pp``;
+    PP×TP inside stages for the first two), Llama blocks and head
+    (``models/llama.py``)."""
 
     vocab_size: int = 32000
     max_len: int = 4096
@@ -442,16 +442,23 @@ class PipelinedLlama(nn.Module):
     dtype: jnp.dtype = jnp.float32
     mesh: object = None
 
+    def _stage_arch(self) -> dict:
+        return dict(
+            num_heads=self.num_heads,
+            head_dim=self.embed_dim // self.num_heads,
+            mlp_dim=self.mlp_dim,
+            ln_eps=self.rms_eps,
+            dtype=self.dtype,
+            block_kind="llama",
+            num_kv_heads=self.num_kv_heads,
+            rope_theta=self.rope_theta,
+        )
+
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         from .llama import RMSNorm
         from .transformer import dense_init
 
-        if self.schedule == "1f1b_interleaved":
-            raise NotImplementedError(
-                "schedule='1f1b_interleaved' is wired for gpt2_pp only; "
-                "use 'gpipe' or '1f1b' with llama_pp"
-            )
         B, L = tokens.shape
         if L > self.max_len:
             raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
@@ -470,18 +477,11 @@ class PipelinedLlama(nn.Module):
             num_layers=self.num_layers,
             num_stages=self.num_stages,
             num_microbatches=self.num_microbatches,
-            num_heads=self.num_heads,
-            head_dim=self.embed_dim // self.num_heads,
-            mlp_dim=self.mlp_dim,
-            ln_eps=self.rms_eps,
-            dtype=self.dtype,
             pipeline=self.pipeline,
             schedule=self.schedule,
             mesh=self.mesh,
-            block_kind="llama",
-            num_kv_heads=self.num_kv_heads,
-            rope_theta=self.rope_theta,
             name="h",
+            **self._stage_arch(),
         )(x, None, not train)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
         kernel = self.param(
@@ -495,6 +495,63 @@ class PipelinedLlama(nn.Module):
             "ble,ev->blv", x, jnp.asarray(kernel, self.dtype)
         )
         return logits.astype(jnp.float32)
+
+    # -- true interleaved 1F1B (schedule='1f1b_interleaved') ---------------
+
+    def pipeline_value_and_grad(self, params, batch, mesh):
+        """(loss, grads) via :func:`parallel.pp.interleaved_1f1b` — the
+        Llama counterpart of :meth:`PipelinedGPT2.pipeline_value_and_grad`
+        (same engine, Llama embed/stage/head closures). Causal-LM batches
+        only; PP×TP not supported on this path (use schedule='1f1b')."""
+        import optax
+
+        from ..parallel.pp import interleaved_1f1b
+        from .llama import RMSNorm
+
+        if mesh.shape["tp"] > 1:
+            raise NotImplementedError(
+                "schedule='1f1b_interleaved' does not compose with tp>1 "
+                "yet; use schedule='1f1b'"
+            )
+        stage_mod = PipelineStage(
+            self.num_layers // self.num_stages,
+            parent=None,
+            **self._stage_arch(),
+        )
+        embed_mod = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype, parent=None
+        )
+        norm_mod = RMSNorm(self.rms_eps, self.dtype, parent=None)
+
+        def embed_fn(shared, bm):
+            tok = bm["tokens"][:, :-1]
+            return embed_mod.apply(
+                {"params": shared["embed"]}, tok
+            ).astype(self.dtype)
+
+        def stage_fn(stage_params, y):
+            with nn.logical_axis_rules(()):
+                return stage_mod.apply({"params": stage_params}, y, True)
+
+        def head_fn(shared, y, bm):
+            x = norm_mod.apply({"params": shared["norm"]}, y)
+            logits = jnp.einsum(
+                "ble,ev->blv", x, jnp.asarray(shared["lm_head"], self.dtype)
+            ).astype(jnp.float32)
+            targets = bm["tokens"][:, 1:]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+
+        stacked = params["h"]["stages"]
+        shared = {k: params[k] for k in ("embed", "norm", "lm_head")}
+        loss, (dstacked, dshared) = interleaved_1f1b(
+            embed_fn, stage_fn, head_fn, stacked, shared,
+            {"tokens": batch["tokens"]},
+            mesh=mesh, num_microbatches=self.num_microbatches,
+        )
+        grads = {**dshared, "h": {"stages": dstacked}}
+        return loss, grads
 
 
 @register("llama_pp")

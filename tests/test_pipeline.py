@@ -431,22 +431,23 @@ class TestPipelinedLlama:
         np.testing.assert_allclose(ref, pp, rtol=2e-5)
 
     def test_pp2_tp2_composes(self, mesh1, mesh_factory):
-        # PP×TP with GQA: kv heads (2) split across tp=2 inside stages.
+        # PP×TP with GQA: kv heads (2) split across tp=2 inside stages,
+        # under both schedules (mirroring the GPT-2 counterpart).
         ref = _train_losses(
             mesh1, pipeline=False, num_stages=2, model_name="llama_pp"
         )
-        pp = _train_losses(
-            mesh_factory(dp=2, pp=2, tp=2), pipeline=True, num_stages=2,
-            model_name="llama_pp",
-        )
-        np.testing.assert_allclose(ref, pp, rtol=2e-5)
-
-    def test_interleaved_rejected_loudly(self, mesh_factory):
-        import pytest
-
-        mesh = mesh_factory(dp=2, pp=4)
-        with pytest.raises(NotImplementedError, match="gpt2_pp only"):
-            _train_losses(
-                mesh, pipeline=True, schedule="1f1b_interleaved",
-                model_name="llama_pp",
+        for schedule in ("gpipe", "1f1b"):
+            pp = _train_losses(
+                mesh_factory(dp=2, pp=2, tp=2), pipeline=True, num_stages=2,
+                schedule=schedule, model_name="llama_pp",
             )
+            np.testing.assert_allclose(ref, pp, rtol=2e-5)
+
+    def test_interleaved_1f1b_matches_sequential(self, mesh1, mesh_factory):
+        # The grads-inside engine with Llama embed/stage/head closures.
+        ref = _train_losses(mesh1, pipeline=False, model_name="llama_pp")
+        inter = _train_losses(
+            mesh_factory(dp=2, pp=4), pipeline=True,
+            schedule="1f1b_interleaved", model_name="llama_pp",
+        )
+        np.testing.assert_allclose(ref, inter, rtol=2e-5)
