@@ -1,0 +1,114 @@
+"""Autoregressive generation with a KV cache (GPT-2 / Llama).
+
+Beyond the reference's scope (it is a trainer, ``BASELINE.json:5``) but part
+of a complete framework: a model you trained or ported (``hf_port``) can be
+sampled from without leaving JAX.
+
+TPU-first shape discipline: the whole loop is ONE ``lax.scan`` inside one
+``jit`` — fixed-size token buffer, one-token decode steps against
+per-layer KV caches (``transformer.decode_attention``), no Python in the
+loop and no recompilation across calls with the same shapes. Per-step
+attention touches only cached keys (O(L) per token instead of the O(L²)
+full-prefix recompute).
+
+    tokens = generate(model, params, prompt, max_new_tokens=32)   # greedy
+    tokens = generate(..., temperature=0.8, rng=jax.random.PRNGKey(0))
+
+``model`` must support ``decode=True`` (GPT-2 and Llama do; their fused
+kernels are a training feature — decoding runs the xla core, so pass a
+model with ``attn_impl='xla'``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _logits_of(out):
+    """Full-logits or chunked-head model output -> [B, 1, V] logits."""
+    from .ops.chunked_xent import is_chunked_head
+
+    if is_chunked_head(out):
+        logits = jnp.einsum(
+            "ble,ve->blv", out["hidden"], out["emb"]
+        ).astype(jnp.float32)
+        if "bias" in out:
+            logits = logits + out["bias"]
+        return logits
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("max_new_tokens", "sample"),
+)
+def _generate_jit(model, params, prompt, rng, temperature, *,
+                  max_new_tokens, sample):
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    cache = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((B, total), jnp.int32)
+    )["cache"]
+    buf = jnp.concatenate(
+        [prompt.astype(jnp.int32), jnp.zeros((B, max_new_tokens), jnp.int32)],
+        axis=1,
+    )
+
+    def step(carry, i):
+        buf, cache, rng = carry
+        tok = lax.dynamic_slice(buf, (0, i), (B, 1))
+        out, vars_ = model.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"]
+        )
+        logits = _logits_of(out)[:, -1, :]
+        if sample:
+            # temperature is a TRACED operand: sweeping it re-runs, never
+            # recompiles (only the greedy/sampling branch is static).
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        # Positions < P-1 keep the prompt token already in the buffer;
+        # the model still consumed tok so its KV cache covers the prefix.
+        keep_prompt = (i + 1) < P
+        cur = lax.dynamic_slice(buf, (0, i + 1), (B, 1))[:, 0]
+        nxt = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
+        buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, i + 1))
+        return (buf, vars_["cache"], rng), None
+
+    (buf, _, _), _ = lax.scan(
+        step, (buf, cache, rng), jnp.arange(total - 1)
+    )
+    return buf
+
+
+def generate(
+    model,
+    params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng=None,
+):
+    """Generate ``max_new_tokens`` after ``prompt`` [B, P] int32.
+
+    ``temperature=0`` is greedy argmax; ``>0`` samples (``rng`` required).
+    Returns the full [B, P + max_new_tokens] token buffer.
+    """
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature>0) requires rng")
+    if getattr(model, "decode", False) is not True:
+        model = model.clone(decode=True)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_jit(
+        model, params, jnp.asarray(prompt), rng,
+        jnp.float32(temperature if temperature > 0 else 1.0),
+        max_new_tokens=int(max_new_tokens), sample=temperature > 0.0,
+    )

@@ -37,6 +37,9 @@ class GPT2(nn.Module):
     # hidden states + tied decoder for the tasks' chunked cross-entropy
     # (ops/chunked_xent.py; saves ~6.6 GB HBM at the 124m bench config).
     chunked_head: bool = False
+    # KV-cache autoregressive decoding (generate.py): init with the full
+    # generation budget to shape the caches, then feed one token per call.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -62,7 +65,20 @@ class GPT2(nn.Module):
             ),
             name="wpe",
         )
-        x = wte(tokens) + wpe(jnp.arange(L)[None, :])
+        if self.decode:
+            # Position cursor for the cache-decoding path (the attention
+            # cursors live per-layer; this one feeds wpe).
+            pos = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if self.is_initializing():
+                positions = jnp.arange(L)[None, :]
+            else:
+                positions = pos.value + jnp.arange(L)[None, :]
+                pos.value = pos.value + L
+        else:
+            positions = jnp.arange(L)[None, :]
+        x = wte(tokens) + wpe(positions)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = constrain(x, "batch", "seq", "embed")
         x = TransformerStack(
@@ -79,6 +95,7 @@ class GPT2(nn.Module):
             dtype=self.dtype,
             attn_impl=self.attn_impl,
             mesh=self.mesh,
+            decode=self.decode,
             name="h",
         )(x, None, not train)
         x = layer_norm(1e-5, self.dtype, "ln_f")(x)

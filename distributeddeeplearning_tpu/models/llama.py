@@ -33,7 +33,7 @@ from flax import linen as nn
 
 from . import register
 from ..sharding import constrain
-from .transformer import attention_core, dense_init
+from .transformer import attention_core, decode_attention, dense_init
 
 
 class RMSNorm(nn.Module):
@@ -89,6 +89,7 @@ class LlamaAttention(nn.Module):
     # out-projection over this axis (projections are bias-free, so no
     # bias pre-scaling is needed — cf. transformer.SelfAttention).
     psum_axis: str | None = None
+    decode: bool = False  # KV-cache decoding (transformer.decode_attention)
 
     @nn.compact
     def __call__(self, x):
@@ -114,21 +115,38 @@ class LlamaAttention(nn.Module):
         k = proj("key", self.num_kv_heads)(x)
         v = proj("value", self.num_kv_heads)(x)
 
-        cos, sin = rope_tables(jnp.arange(L), self.head_dim, self.rope_theta)
+        positions = jnp.arange(L)
+        idx_var = None
+        if self.decode:
+            # RoPE at the cache cursor; the variable is registered ONCE
+            # here and passed into decode_attention (which advances it).
+            idx_var = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if not self.is_initializing():
+                positions = idx_var.value + positions
+        cos, sin = rope_tables(positions, self.head_dim, self.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
         # GQA: repeat KV groups up to the query head count, then run any
         # MHA core. HF orders repeats group-major (head g*r+i reads kv g).
+        # (Decode caches the repeated kv — simple over minimal.)
         rep = self.num_heads // self.num_kv_heads
         if rep > 1:
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        out = attention_core(
-            q, k, v, impl=self.attn_impl, causal=True, dtype=self.dtype,
-            mesh=self.mesh,
-        )
+        if self.decode:
+            out = decode_attention(
+                self, q, k, v, dtype=self.dtype, attn_impl=self.attn_impl,
+                idx_var=idx_var,
+            )
+        else:
+            out = attention_core(
+                q, k, v, impl=self.attn_impl, causal=True, dtype=self.dtype,
+                mesh=self.mesh,
+            )
 
         out = nn.DenseGeneral(
             features=E,
@@ -191,6 +209,7 @@ class LlamaBlock(nn.Module):
     # False inside pipeline stages: the body runs under shard_map on
     # per-device arrays, where global sharding constraints don't apply.
     constrain_out: bool = True
+    decode: bool = False  # KV-cache decoding
 
     @nn.compact
     def __call__(self, x):
@@ -198,7 +217,7 @@ class LlamaBlock(nn.Module):
             self.num_heads, self.num_kv_heads, self.head_dim,
             rope_theta=self.rope_theta, dtype=self.dtype,
             attn_impl=self.attn_impl, mesh=self.mesh,
-            psum_axis=self.psum_axis, name="attn",
+            psum_axis=self.psum_axis, decode=self.decode, name="attn",
         )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
         if self.constrain_out:
             x = constrain(x, "batch", "seq", "embed")
@@ -223,6 +242,9 @@ class Llama(nn.Module):
     attn_impl: str = "xla"
     mesh: object = None
     chunked_head: bool = False
+    # KV-cache autoregressive decoding (generate.py): init with the full
+    # generation budget to shape the caches, then feed one token per call.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -248,7 +270,7 @@ class Llama(nn.Module):
                 self.embed_dim // self.num_heads, self.mlp_dim,
                 rope_theta=self.rope_theta, rms_eps=self.rms_eps,
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
-                name=f"block_{i}",
+                decode=self.decode, name=f"block_{i}",
             )(x)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
         # Untied LM head as an explicit param so both head modes share one

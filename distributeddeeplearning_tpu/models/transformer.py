@@ -97,6 +97,49 @@ def attention_core(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def decode_attention(module, q, k, v, *, dtype, attn_impl="xla",
+                     idx_var=None):
+    """One autoregressive decode step against a KV cache (used by
+    ``SelfAttention`` and ``models/llama.LlamaAttention`` when
+    ``decode=True``; driven by ``generate.py``).
+
+    The cache lives in the module's ``'cache'`` variable collection:
+    ``cached_key``/``cached_value`` sized by the INIT call's sequence length
+    (= the total generation budget) and a ``cache_index`` cursor. Real
+    calls feed one token: its k/v are written at the cursor, q attends over
+    the visible prefix, the cursor advances.
+    """
+    if attn_impl != "xla":
+        raise NotImplementedError(
+            f"decode supports attn_impl='xla' only, got {attn_impl!r} "
+            "(the fused kernels have no incremental path)"
+        )
+    ck = module.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+    cv = module.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+    # A compact module may only register a name once — callers that read
+    # the cursor themselves (Llama's RoPE offset) pass it in.
+    idx = idx_var if idx_var is not None else module.variable(
+        "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+    )
+    if module.is_initializing():
+        # Shape-only pass: create the cache at this call's length and run
+        # plain causal attention so init produces valid outputs.
+        return attention_core(q, k, v, impl="xla", causal=True, dtype=dtype)
+    B, L, H, D = q.shape
+    if L != 1:
+        raise ValueError(f"decode feeds one token at a time, got L={L}")
+    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx.value, 0, 0))
+    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx.value, 0, 0))
+    max_len = ck.value.shape[1]
+    visible = (jnp.arange(max_len) <= idx.value)[None, None, None, :]
+    out = attention_core(
+        q, ck.value, cv.value, impl="xla", causal=False, dtype=dtype,
+        mask=visible,
+    )
+    idx.value = idx.value + 1
+    return out
+
+
 class SelfAttention(nn.Module):
     """Multi-head self-attention with logical-axis-annotated projections.
 
@@ -134,6 +177,11 @@ class SelfAttention(nn.Module):
     # out-projection over this axis. The out bias must be pre-scaled 1/tp by
     # the caller (it is added per-rank before the psum).
     psum_axis: str | None = None
+    # Autoregressive decoding with a KV cache (generate.py): the module
+    # keeps cached_key/cached_value/cache_index in the 'cache' collection.
+    # The init call (any length) only shapes the cache; real calls then
+    # feed ONE token at a time. attn_impl='xla' only.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -153,7 +201,15 @@ class SelfAttention(nn.Module):
         k = proj("key")(x)
         v = proj("value")(x)
 
-        if self.attn_impl == "flash":
+        if self.decode:
+            if mask is not None:
+                raise NotImplementedError(
+                    "decode ignores key-padding masks — pad-free prompts "
+                    "only (the cache visibility mask is cursor-based)"
+                )
+            out = decode_attention(self, q, k, v, dtype=self.dtype,
+                                   attn_impl=self.attn_impl)
+        elif self.attn_impl == "flash":
             if self.dropout_rate and not deterministic:
                 raise NotImplementedError(
                     "flash attention supports no active attention-dropout"
@@ -321,6 +377,7 @@ class TransformerBlock(nn.Module):
     constrain_out: bool = True
     # Manual TP inside shard_map (PP×TP): forwarded to the attn/mlp modules.
     psum_axis: str | None = None
+    decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -334,6 +391,7 @@ class TransformerBlock(nn.Module):
             attn_impl=self.attn_impl,
             mesh=self.mesh,
             psum_axis=self.psum_axis,
+            decode=self.decode,
             name="attn",
         )
         mlp = Mlp(
@@ -377,6 +435,7 @@ class TransformerStack(nn.Module):
     init_scale: float = 0.02
     attn_impl: str = "xla"
     mesh: object = None
+    decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -403,6 +462,7 @@ class TransformerStack(nn.Module):
                 init_scale=self.init_scale,
                 attn_impl=self.attn_impl,
                 mesh=self.mesh,
+                decode=self.decode,
                 name=f"block_{i}",
             )(x, mask, deterministic)
         return x
