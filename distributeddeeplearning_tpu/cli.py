@@ -93,6 +93,27 @@ def make_eval_fn(cfg: Config, mesh, dataset=None):
     return eval_batches
 
 
+def _restore_or_init(cfg: Config, trainer, probe_batch, verb: str):
+    """Latest checkpoint (when ``train.checkpoint_dir`` has one) or a fresh
+    init — the shared preamble of every non-training subcommand."""
+    if cfg.train.checkpoint_dir:
+        from .checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+        try:
+            if ckpt.latest_step() is not None:
+                trainer.setup(probe_batch)
+                state, _ = ckpt.restore(
+                    trainer.abstract_state_with_shardings()
+                )
+                print(f"{verb} checkpoint at step {int(state.step)}")
+                return state
+        finally:
+            ckpt.close()
+    print(f"no checkpoint found — {verb} freshly initialized params")
+    return trainer.init(cfg.train.seed, probe_batch)
+
+
 def cmd_eval(cfg: Config) -> int:
     """Standalone evaluation: restore the latest checkpoint (or init fresh
     when none exists) and report mean eval metrics — top-1 ``eval_accuracy``
@@ -100,22 +121,71 @@ def cmd_eval(cfg: Config) -> int:
     from .train import evaluate
 
     mesh, _, trainer, eval_ds = build_all(cfg, split="eval")
-    state = None
-    if cfg.train.checkpoint_dir:
-        from .checkpoint import CheckpointManager
-
-        ckpt = CheckpointManager(cfg.train.checkpoint_dir)
-        if ckpt.latest_step() is not None:
-            trainer.setup(eval_ds.batch(0))
-            state, _ = ckpt.restore(trainer.abstract_state_with_shardings())
-            print(f"evaluating checkpoint at step {int(state.step)}")
-        ckpt.close()
-    if state is None:
-        print("no checkpoint found — evaluating freshly initialized params")
-        state = trainer.init(cfg.train.seed, eval_ds.batch(0))
+    state = _restore_or_init(cfg, trainer, eval_ds.batch(0), "evaluating")
     metrics = evaluate(trainer, state, make_eval_fn(cfg, mesh, dataset=eval_ds)())
     metrics["step"] = int(state.step)
     print(json.dumps(metrics))
+    return 0
+
+
+def cmd_generate(cfg: Config, prompt: str, max_new_tokens: int,
+                 temperature: float, seed: int) -> int:
+    """Sample text from the latest checkpoint (or fresh init) with the
+    KV-cache decoder (``generate.py``). Assumes a BYTE tokenizer
+    (``prepare_data --tokenizer byte``): the prompt is encoded as UTF-8
+    bytes, the completion decoded back."""
+    import numpy as np
+
+    from .generate import generate as run_generate
+
+    mesh, model, trainer, dataset = build_all(cfg)
+    if not hasattr(model, "decode"):
+        raise ValueError(
+            f"model {cfg.model.name!r} has no KV-cache decode support"
+        )
+    # Byte tokenizer ONLY: any other vocab would make the UTF-8 prompt
+    # encoding and completion decoding silently meaningless (a BPE model's
+    # ids are not bytes) — refuse rather than print garbage.
+    vocab = getattr(model, "vocab_size", 0)
+    if vocab != 256:
+        raise ValueError(
+            f"cli generate requires a byte-tokenizer model "
+            f"(vocab_size=256, got {vocab}): prompts are encoded as UTF-8 "
+            "bytes and completions decoded back (prepare_data "
+            "--tokenizer byte). Use generate.generate() directly for "
+            "other tokenizers."
+        )
+    state = _restore_or_init(cfg, trainer, dataset.batch(0), "generating from")
+
+    tokens = np.frombuffer(
+        prompt.encode("utf-8"), np.uint8
+    ).astype(np.int32)[None, :]
+    if tokens.size == 0:
+        raise ValueError("prompt must be non-empty")
+    if tokens.shape[1] + max_new_tokens > getattr(model, "max_len", 1 << 30):
+        raise ValueError(
+            f"prompt ({tokens.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) exceeds model max_len {model.max_len}"
+        )
+    # Decoding runs the xla core on one program — drop kernel/mesh options.
+    updates = {}
+    if hasattr(model, "attn_impl"):
+        updates["attn_impl"] = "xla"
+    if hasattr(model, "mesh") and model.mesh is not None:
+        updates["mesh"] = None
+    if updates:
+        model = model.clone(**updates)
+    out = run_generate(
+        model, state.params, tokens, max_new_tokens=max_new_tokens,
+        temperature=temperature, rng=jax.random.PRNGKey(seed),
+    )
+    new = np.asarray(out[0, tokens.shape[1]:])
+    completion = bytes(int(t) for t in new).decode(
+        "utf-8", errors="replace"
+    )
+    print(json.dumps({
+        "step": int(state.step), "prompt": prompt, "completion": completion,
+    }))
     return 0
 
 
@@ -181,7 +251,7 @@ def cmd_train(cfg: Config) -> int:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="distributeddeeplearning_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("train", "eval", "benchmark"):
+    for name in ("train", "eval", "benchmark", "generate"):
         p = sub.add_parser(name)
         p.add_argument("--config", required=True, help="path to a config .py")
         p.add_argument(
@@ -197,6 +267,11 @@ def main(argv=None) -> int:
             help="apply mesh.XLA_PERF_FLAGS (async-collective overlap) "
             "before backend init",
         )
+        if name == "generate":
+            p.add_argument("--prompt", required=True)
+            p.add_argument("--max-new-tokens", type=int, default=64)
+            p.add_argument("--temperature", type=float, default=0.0)
+            p.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
     if args.xla_perf_flags:
         # Env-level, so it must precede EVERY backend touch — including the
@@ -214,6 +289,11 @@ def main(argv=None) -> int:
         return cmd_train(cfg)
     if args.cmd == "eval":
         return cmd_eval(cfg)
+    if args.cmd == "generate":
+        return cmd_generate(
+            cfg, args.prompt, args.max_new_tokens, args.temperature,
+            args.seed,
+        )
     if args.cmd == "benchmark":
         try:
             from .benchmark import run_benchmark

@@ -118,3 +118,51 @@ def test_chunked_head_model_generates():
     want = _greedy_oracle(model, params, prompt, max_new_tokens=5)
     got = generate(model, params, prompt, max_new_tokens=5)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_cli_generate_from_trained_checkpoint(tmp_path, capsys):
+    """End to end: train GPT-2 briefly on a byte-tokenized corpus with a
+    strong repeating structure, checkpoint, then `generate` continues the
+    pattern from the checkpoint via the CLI."""
+    import json
+
+    from distributeddeeplearning_tpu.cli import main
+    from distributeddeeplearning_tpu.data_text import write_token_file
+
+    corpus = (b"abcdefgh" * 600)
+    tok_path = str(tmp_path / "corpus.tok")
+    write_token_file(
+        tok_path, np.frombuffer(corpus, np.uint8).astype(np.int64), 256
+    )
+    common = [
+        "--config", "configs/gpt2_owt.py",
+        "--override",
+        'model.kwargs={"size":"tiny","vocab_size":256,"max_len":64}',
+        "--override", "data.kind=token_file_lm",
+        "--override", f"data.path={tok_path}",
+        "--override", "data.batch_size=8", "--override", "data.seq_len=32",
+        "--override", "optim.name=adamw", "--override", "optim.lr=0.01",
+        "--override", "optim.warmup_steps=0",
+        "--override", f"train.checkpoint_dir={tmp_path}/ckpt",
+    ]
+    assert main([
+        "train", *common,
+        "--override", "train.steps=40", "--override", "train.log_every=20",
+        "--override", "train.save_every=20",
+    ]) == 0
+    assert main([
+        "generate", *common, "--prompt", "abcdefghabc",
+        "--max-new-tokens", "8",
+    ]) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["step"] == 40
+    # The byte model must have learned the 8-cycle: continue 'abc' -> 'defgh...'
+    assert rec["completion"].startswith("defgh")
+    # Non-byte vocab is refused loudly (BPE ids are not bytes).
+    with pytest.raises(ValueError, match="byte-tokenizer"):
+        main([
+            "generate", "--config", "configs/gpt2_owt.py",
+            "--override", "model.kwargs.size=tiny",
+            "--prompt", "hi", "--max-new-tokens", "2",
+        ])
