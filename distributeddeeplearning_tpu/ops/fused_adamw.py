@@ -3,9 +3,10 @@
 TPU-native equivalent of the reference's hand-written "CUDA optimizer
 step" (``BASELINE.json:5``): ONE VPU pass over the whole parameter tree —
 all kernel-sized leaves are flattened into a single padded ``(rows, 128)``
-buffer per param dtype, so the step compiles one kernel variant and pays
-one launch instead of one per leaf (dozens of remote Mosaic compiles for
-GPT-2 otherwise). The trade: the per-step ``concatenate``/slice costs one
+buffer per (param dtype, decay group), so the step compiles one kernel
+variant and pays one launch per group — at most two per dtype with
+weight decay on (decayed matrices vs undecayed norm scales) — instead of
+one per leaf (dozens of remote Mosaic compiles for GPT-2 otherwise). The trade: the per-step ``concatenate``/slice costs one
 extra HBM round trip of the p/g/m/v buffers around the kernel; storing the
 moments flat (so no per-step concat is needed) is the known next step. XLA
 already fuses the optax elementwise chain well, so this kernel is an
@@ -183,10 +184,12 @@ def fused_adamw(
         c1 = 1.0 / (1.0 - jnp.power(b1, t))
         c2 = 1.0 / (1.0 - jnp.power(b2, t))
 
-        # ONE kernel launch per param dtype: all kernel-sized leaves are
-        # flattened into a single (rows, 128) buffer. A per-leaf pallas_call
-        # would compile one kernel VARIANT per distinct leaf shape (~dozens
-        # for GPT-2) and pay a launch per leaf per step; concatenation is
+        # ONE kernel launch per (param dtype, decay group): all kernel-sized
+        # leaves of a group are flattened into a single (rows, 128) buffer
+        # (so at most two launches per dtype when weight_decay > 0 — decayed
+        # matrices vs undecayed norm scales). A per-leaf pallas_call would
+        # compile one kernel VARIANT per distinct leaf shape (~dozens for
+        # GPT-2) and pay a launch per leaf per step; concatenation is
         # shard-local, so this composes unchanged with the Trainer's
         # shard_map dispatch over ZeRO/FSDP-sharded state.
         treedef = jax.tree.structure(params)
@@ -201,6 +204,11 @@ def fused_adamw(
 
         groups: dict = {}
         for i, p in enumerate(p_leaves):
+            # Standard AdamW masking: no decay on ndim<2 params (biases,
+            # LayerNorm/RMSNorm scales) — decaying a norm scale toward zero
+            # is a regularization bug, not regularization. Same rule as
+            # make_optimizer's optax.adamw mask (train.py).
+            wd_i = weight_decay if p.ndim >= 2 else 0.0
             if p.size < _MIN_KERNEL_SIZE:
                 # A kernel launch per bias vector costs more than it saves.
                 gf = g_leaves[i].astype(jnp.float32)
@@ -208,20 +216,20 @@ def fused_adamw(
                 v2 = b2 * v_leaves[i] + (1.0 - b2) * gf * gf
                 deltas[i] = (
                     -lr * (m2 * c1 / (jnp.sqrt(v2 * c2) + eps)
-                           + weight_decay * p.astype(jnp.float32))
+                           + wd_i * p.astype(jnp.float32))
                 ).astype(p.dtype)
                 nms[i], nvs[i] = m2, v2
             else:
-                groups.setdefault(jnp.dtype(p.dtype), []).append(i)
+                groups.setdefault((jnp.dtype(p.dtype), wd_i), []).append(i)
 
-        for dtype, idxs in groups.items():
+        for (dtype, wd_i), idxs in groups.items():
             flat = lambda leaves: jnp.concatenate(  # noqa: E731
                 [leaves[i].reshape(-1) for i in idxs]
             )
             d_f, nm_f, nv_f = _fused_leaf(
                 flat(p_leaves), flat(g_leaves), flat(m_leaves), flat(v_leaves),
                 lr, c1, c2,
-                b1=b1, b2=b2, eps=eps, wd=weight_decay, interpret=ip,
+                b1=b1, b2=b2, eps=eps, wd=wd_i, interpret=ip,
             )
             off = 0
             for i in idxs:

@@ -189,7 +189,15 @@ def make_optimizer(
         if weight_decay:
             tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
     elif name == "adamw":
-        tx = optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay)
+        # Standard AdamW masking: no decay on ndim<2 params (biases and
+        # norm scales); matrices and embeddings decay. Mirrored by the
+        # fused kernel (ops/fused_adamw.py).
+        tx = optax.adamw(
+            sched, b1=b1, b2=b2, weight_decay=weight_decay,
+            mask=lambda params: jax.tree.map(
+                lambda p: jnp.ndim(p) >= 2, params
+            ),
+        )
     elif name == "adamw_fused":
         from .ops.fused_adamw import fused_adamw
 

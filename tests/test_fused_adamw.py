@@ -18,13 +18,21 @@ def _tree(key):
         "w": jax.random.normal(k1, (37, 129)),
         "b": jax.random.normal(k2, (7,)),  # small leaf -> jnp path
         "bf16": jax.random.normal(k3, (64, 128)).astype(jnp.bfloat16),
+        # ndim<2 but kernel-sized: forms the no-decay kernel group (the
+        # RMSNorm-scale-at-large-hidden case the decay mask exists for).
+        "scale": jax.random.normal(jax.random.fold_in(k2, 1), (2048,)),
     }
 
 
 @pytest.mark.parametrize("wd", [0.0, 0.1])
 def test_matches_optax_adamw(wd):
     params = _tree(jax.random.PRNGKey(0))
-    ref_tx = optax.adamw(1e-2, b1=0.9, b2=0.95, weight_decay=wd)
+    # Same masking as make_optimizer: ndim<2 leaves (the "b" bias here)
+    # get no decay in BOTH implementations.
+    ref_tx = optax.adamw(
+        1e-2, b1=0.9, b2=0.95, weight_decay=wd,
+        mask=lambda ps: jax.tree.map(lambda p: jnp.ndim(p) >= 2, ps),
+    )
     fus_tx = fused_adamw(1e-2, b1=0.9, b2=0.95, weight_decay=wd)
     ref_state, fus_state = ref_tx.init(params), fus_tx.init(params)
     p_ref = p_fus = params
