@@ -30,6 +30,9 @@ def get_config() -> Config:
             name="sgd", lr=0.4, momentum=0.9, schedule="cosine",
             warmup_steps=500, weight_decay=1e-4,
         ),
-        train=TrainConfig(steps=1000, log_every=20, task="classification"),
+        train=TrainConfig(
+            steps=1000, log_every=20, task="classification",
+            label_smoothing=0.1,  # MLPerf ResNet recipe
+        ),
         mesh=MeshConfig(dp=-1),
     )

@@ -43,6 +43,7 @@ def get_config() -> Config:
             schedule="cosine", warmup_steps=1000,
         ),
         train=TrainConfig(
+            label_smoothing=0.1,  # MLPerf ResNet recipe
             steps=450000,  # 90 epochs of 1.28M images at batch 256
             log_every=50,
             task="classification",

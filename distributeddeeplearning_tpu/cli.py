@@ -60,7 +60,11 @@ def build_all(cfg: Config, split: str = "train"):
         model,
         tx,
         # get_task drops knobs a task's factory doesn't declare.
-        get_task(cfg.train.task, head_chunk=cfg.train.head_chunk),
+        get_task(
+            cfg.train.task,
+            head_chunk=cfg.train.head_chunk,
+            label_smoothing=cfg.train.label_smoothing,
+        ),
         mesh,
         grad_accum=cfg.train.grad_accum,
         zero1=cfg.train.zero1,

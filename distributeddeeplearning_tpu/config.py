@@ -132,6 +132,8 @@ class TrainConfig:
     # lm/mlm with a chunked_head model: sequence positions per chunked
     # cross-entropy scan step (ops/chunked_xent.py). Ignored otherwise.
     head_chunk: int = 128
+    # classification: label-smoothing ε (MLPerf ResNet-50 uses 0.1).
+    label_smoothing: float = 0.0
     log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
     # Debug/fault tooling (SURVEY §5): the XLA-world equivalents of the

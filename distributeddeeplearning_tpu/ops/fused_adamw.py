@@ -131,6 +131,13 @@ class FusedAdamWTransformation(NamedTuple):
     grad_clip: float = 0.0
 
 
+def decay_leaf(p) -> bool:
+    """THE weight-decay rule, defined once: matrices/embeddings (ndim>=2)
+    decay; biases and norm scales (ndim<2) don't. Used by this kernel, by
+    ``train.make_optimizer``'s optax paths, and by the parity tests."""
+    return jnp.ndim(p) >= 2
+
+
 def _clip_by_global_norm(grads, clip: float):
     norm = optax.global_norm(grads)
     scale = clip / jnp.maximum(norm, clip)
@@ -204,11 +211,9 @@ def fused_adamw(
 
         groups: dict = {}
         for i, p in enumerate(p_leaves):
-            # Standard AdamW masking: no decay on ndim<2 params (biases,
-            # LayerNorm/RMSNorm scales) — decaying a norm scale toward zero
-            # is a regularization bug, not regularization. Same rule as
-            # make_optimizer's optax.adamw mask (train.py).
-            wd_i = weight_decay if p.ndim >= 2 else 0.0
+            # Standard AdamW masking (decay_leaf): decaying a norm scale
+            # toward zero is a regularization bug, not regularization.
+            wd_i = weight_decay if decay_leaf(p) else 0.0
             if p.size < _MIN_KERNEL_SIZE:
                 # A kernel launch per bias vector costs more than it saves.
                 gf = g_leaves[i].astype(jnp.float32)

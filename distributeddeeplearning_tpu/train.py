@@ -74,11 +74,28 @@ def _xent(logits, labels):
     )
 
 
-def classification_task() -> Task:
+def classification_task(label_smoothing: float = 0.0) -> Task:
+    """``label_smoothing``: standard (1-ε) one-hot + ε/K smoothing — the
+    MLPerf ResNet-50 recipe uses 0.1 (BASELINE.json:2 "top-1 parity")."""
+
     def loss_fn(logits, batch):
-        loss = _xent(logits, batch["label"]).mean()
-        acc = (logits.argmax(-1) == batch["label"]).mean()
-        return loss, {"loss": loss, "accuracy": acc}
+        labels = batch["label"]
+        xent = _xent(logits, labels).mean()
+        if label_smoothing:
+            k = logits.shape[-1]
+            soft = optax.smooth_labels(
+                jax.nn.one_hot(labels, k), label_smoothing
+            )
+            loss = optax.softmax_cross_entropy(
+                logits.astype(jnp.float32), soft
+            ).mean()
+        else:
+            loss = xent
+        acc = (logits.argmax(-1) == labels).mean()
+        # 'loss' is the training objective (smoothing raises its floor by
+        # ~eps*ln(K)); 'xent' stays the plain cross-entropy so eval_xent is
+        # comparable across smoothing settings and to published baselines.
+        return loss, {"loss": loss, "xent": xent, "accuracy": acc}
 
     return Task(input_fn=lambda b: (b["image"],), loss_fn=loss_fn)
 
@@ -184,19 +201,24 @@ def make_optimizer(
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
 
+    # THE decay rule, shared with the fused kernel so the optax and Pallas
+    # optimizers cannot diverge: biases / BN / norm scales are not decayed
+    # (the MLPerf ResNet recipe — a real lever for BASELINE.json:2's
+    # "top-1 parity").
+    from .ops.fused_adamw import decay_leaf
+
+    decay_mask = lambda params: jax.tree.map(decay_leaf, params)  # noqa: E731
+
     if name == "sgd":
         tx = optax.sgd(sched, momentum=momentum, nesterov=False)
         if weight_decay:
-            tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+            tx = optax.chain(
+                optax.add_decayed_weights(weight_decay, mask=decay_mask),
+                tx,
+            )
     elif name == "adamw":
-        # Standard AdamW masking: no decay on ndim<2 params (biases and
-        # norm scales); matrices and embeddings decay. Mirrored by the
-        # fused kernel (ops/fused_adamw.py).
         tx = optax.adamw(
-            sched, b1=b1, b2=b2, weight_decay=weight_decay,
-            mask=lambda params: jax.tree.map(
-                lambda p: jnp.ndim(p) >= 2, params
-            ),
+            sched, b1=b1, b2=b2, weight_decay=weight_decay, mask=decay_mask
         )
     elif name == "adamw_fused":
         from .ops.fused_adamw import fused_adamw

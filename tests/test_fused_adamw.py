@@ -28,10 +28,12 @@ def _tree(key):
 def test_matches_optax_adamw(wd):
     params = _tree(jax.random.PRNGKey(0))
     # Same masking as make_optimizer: ndim<2 leaves (the "b" bias here)
-    # get no decay in BOTH implementations.
+    # get no decay in BOTH implementations (ops.fused_adamw.decay_leaf).
+    from distributeddeeplearning_tpu.ops.fused_adamw import decay_leaf
+
     ref_tx = optax.adamw(
         1e-2, b1=0.9, b2=0.95, weight_decay=wd,
-        mask=lambda ps: jax.tree.map(lambda p: jnp.ndim(p) >= 2, ps),
+        mask=lambda ps: jax.tree.map(decay_leaf, ps),
     )
     fus_tx = fused_adamw(1e-2, b1=0.9, b2=0.95, weight_decay=wd)
     ref_state, fus_state = ref_tx.init(params), fus_tx.init(params)

@@ -84,3 +84,28 @@ def test_batchnorm_global_stats_match_across_shardings():
     m8 = jax.tree.leaves(s8.model_state)
     for a, b in zip(m1, m8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_label_smoothing_changes_loss_not_training():
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.train import get_task
+
+    logits = jnp.asarray([[2.0, -1.0, 0.5], [0.1, 0.2, 3.0]])
+    batch = {"label": jnp.asarray([0, 2])}
+    plain = get_task("classification")
+    smooth = get_task("classification", label_smoothing=0.1)
+    l0, m0 = plain.loss_fn(logits, batch)
+    l1, m1 = smooth.loss_fn(logits, batch)
+    # Smoothing raises the optimal loss floor but accuracy is unchanged.
+    assert float(l1) > float(l0)
+    assert float(m0["accuracy"]) == float(m1["accuracy"]) == 1.0
+    # Hand-computed reference: (1-eps)-hot + eps/K target cross-entropy.
+    import optax
+
+    soft = optax.smooth_labels(jax.nn.one_hot(batch["label"], 3), 0.1)
+    want = optax.softmax_cross_entropy(logits, soft).mean()
+    assert abs(float(l1) - float(want)) < 1e-6
+    # Knob routing: lm drops label_smoothing instead of crashing.
+    get_task("lm", head_chunk=4, label_smoothing=0.1)
