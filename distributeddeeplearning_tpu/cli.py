@@ -133,7 +133,8 @@ def cmd_eval(cfg: Config) -> int:
 
 
 def cmd_generate(cfg: Config, prompt: str, max_new_tokens: int,
-                 temperature: float, seed: int) -> int:
+                 temperature: float, seed: int, *, top_k: int = 0,
+                 top_p: float = 0.0) -> int:
     """Sample text from the latest checkpoint (or fresh init) with the
     KV-cache decoder (``generate.py``). Assumes a BYTE tokenizer
     (``prepare_data --tokenizer byte``): the prompt is encoded as UTF-8
@@ -181,7 +182,8 @@ def cmd_generate(cfg: Config, prompt: str, max_new_tokens: int,
         model = model.clone(**updates)
     out = run_generate(
         model, state.params, tokens, max_new_tokens=max_new_tokens,
-        temperature=temperature, rng=jax.random.PRNGKey(seed),
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        rng=jax.random.PRNGKey(seed),
     )
     new = np.asarray(out[0, tokens.shape[1]:])
     completion = bytes(int(t) for t in new).decode(
@@ -275,6 +277,8 @@ def main(argv=None) -> int:
             p.add_argument("--prompt", required=True)
             p.add_argument("--max-new-tokens", type=int, default=64)
             p.add_argument("--temperature", type=float, default=0.0)
+            p.add_argument("--top-k", type=int, default=0)
+            p.add_argument("--top-p", type=float, default=0.0)
             p.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
     if args.xla_perf_flags:
@@ -296,7 +300,7 @@ def main(argv=None) -> int:
     if args.cmd == "generate":
         return cmd_generate(
             cfg, args.prompt, args.max_new_tokens, args.temperature,
-            args.seed,
+            args.seed, top_k=args.top_k, top_p=args.top_p,
         )
     if args.cmd == "benchmark":
         try:

@@ -42,13 +42,41 @@ def _logits_of(out):
     return out
 
 
+def _filter_logits(logits, top_k, top_p):
+    """Standard top-k + nucleus (top-p) filtering, [B, V] -> [B, V] with
+    excluded entries at -inf. Expects TEMPERED logits (the caller divides
+    by temperature first — HF's warper order, so the nucleus shrinks as
+    temperature sharpens). Both knobs are TRACED scalars (0 = off), sharing
+    one descending sort, so sweeping them never recompiles."""
+    V = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    # top-k threshold: the kth-largest logit (clamped into [1, V] so an
+    # oversized k degrades to no-op instead of crashing).
+    k = jnp.clip(top_k, 0, V)
+    kth = jax.lax.dynamic_slice_in_dim(
+        sorted_desc, jnp.maximum(k - 1, 0), 1, axis=1
+    )
+    thresh_k = jnp.where(k > 0, kth, -jnp.inf)
+    # nucleus threshold: smallest logit of the minimal prefix whose
+    # cumulative probability reaches top_p (first token always kept).
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    keep_sorted = jnp.cumsum(probs, axis=-1) - probs < top_p
+    thresh_p = jnp.min(
+        jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    thresh_p = jnp.where(top_p > 0, thresh_p, -jnp.inf)
+    return jnp.where(
+        logits < jnp.maximum(thresh_k, thresh_p), -jnp.inf, logits
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnums=(0,),
-    static_argnames=("max_new_tokens", "sample"),
+    static_argnames=("max_new_tokens", "sample", "filtered"),
 )
-def _generate_jit(model, params, prompt, rng, temperature, *,
-                  max_new_tokens, sample):
+def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p, *,
+                  max_new_tokens, sample, filtered):
     B, P = prompt.shape
     total = P + max_new_tokens
     cache = model.init(
@@ -67,10 +95,15 @@ def _generate_jit(model, params, prompt, rng, temperature, *,
         )
         logits = _logits_of(out)[:, -1, :]
         if sample:
-            # temperature is a TRACED operand: sweeping it re-runs, never
-            # recompiles (only the greedy/sampling branch is static).
+            # temperature/top_k/top_p are TRACED operands: sweeping them
+            # re-runs, never recompiles. Temperature FIRST, then filtering
+            # (HF warper order); `filtered` is static only to skip the
+            # per-step sort entirely for plain sampling.
+            logits = logits / temperature
+            if filtered:
+                logits = _filter_logits(logits, top_k, top_p)
             rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            nxt = jax.random.categorical(sub, logits, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         # Positions < P-1 keep the prompt token already in the buffer;
@@ -94,15 +127,20 @@ def generate(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
     rng=None,
 ):
     """Generate ``max_new_tokens`` after ``prompt`` [B, P] int32.
 
-    ``temperature=0`` is greedy argmax; ``>0`` samples (``rng`` required).
-    Returns the full [B, P + max_new_tokens] token buffer.
+    ``temperature=0`` is greedy argmax; ``>0`` samples (``rng`` required),
+    optionally restricted to the ``top_k`` highest logits and/or the
+    ``top_p`` nucleus. Returns the full [B, P + max_new_tokens] buffer.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature>0) requires rng")
+    if temperature == 0.0 and (top_k or top_p):
+        raise ValueError("top_k/top_p only apply when sampling")
     if getattr(model, "decode", False) is not True:
         model = model.clone(decode=True)
     if rng is None:
@@ -110,5 +148,7 @@ def generate(
     return _generate_jit(
         model, params, jnp.asarray(prompt), rng,
         jnp.float32(temperature if temperature > 0 else 1.0),
+        jnp.int32(top_k), jnp.float32(top_p),
         max_new_tokens=int(max_new_tokens), sample=temperature > 0.0,
+        filtered=bool(top_k or top_p),
     )

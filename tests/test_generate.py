@@ -166,3 +166,51 @@ def test_cli_generate_from_trained_checkpoint(tmp_path, capsys):
             "--override", "model.kwargs.size=tiny",
             "--prompt", "hi", "--max-new-tokens", "2",
         ])
+
+
+def test_top_k_and_top_p_filtering():
+    from distributeddeeplearning_tpu.generate import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # top_k=2 keeps exactly the two largest.
+    f = _filter_logits(logits, jnp.int32(2), jnp.float32(0.0))
+    assert np.isfinite(np.asarray(f[0, :2])).all()
+    assert np.isinf(np.asarray(f[0, 2:])).all()
+    # top_p=0.75: cumulative 0.5, 0.8 -> keep {0, 1} (first exceeding mass
+    # is included), drop the tail.
+    f = _filter_logits(logits, jnp.int32(0), jnp.float32(0.75))
+    assert np.isfinite(np.asarray(f[0, :2])).all()
+    assert np.isinf(np.asarray(f[0, 2:])).all()
+    # top_p ~ 0 degenerates to greedy support {argmax}.
+    f = _filter_logits(logits, jnp.int32(0), jnp.float32(1e-6))
+    assert np.isfinite(np.asarray(f[0, 0]))
+    assert np.isinf(np.asarray(f[0, 1:])).all()
+    # Both on: the tighter constraint wins.
+    f = _filter_logits(logits, jnp.int32(1), jnp.float32(0.99))
+    assert np.isfinite(np.asarray(f[0, 0]))
+    assert np.isinf(np.asarray(f[0, 1:])).all()
+    # Oversized k degrades to a no-op instead of crashing.
+    f = _filter_logits(logits, jnp.int32(300), jnp.float32(0.0))
+    assert np.isfinite(np.asarray(f)).all()
+
+
+def test_top_k1_sampling_equals_greedy():
+    model = models.get_model("gpt2", size="tiny", vocab_size=71, max_len=32)
+    prompt = np.random.default_rng(2).integers(0, 71, (2, 5), np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(prompt))["params"]
+    greedy = generate(model, params, prompt, max_new_tokens=6)
+    topk1 = generate(model, params, prompt, max_new_tokens=6,
+                     temperature=1.0, top_k=1, rng=jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        generate(model, params, prompt, max_new_tokens=2, top_k=3)
+    # Sweeping k/p re-runs the SAME compiled program (traced operands).
+    from distributeddeeplearning_tpu.generate import _generate_jit
+
+    # The topk1 call above already compiled the filtered variant at these
+    # shapes; sweeping k/p re-runs that SAME program (traced operands).
+    before = _generate_jit._cache_size()
+    for k, p in [(5, 0.0), (9, 0.5), (3, 0.9)]:
+        generate(model, params, prompt, max_new_tokens=6, temperature=0.8,
+                 top_k=k, top_p=p, rng=jax.random.PRNGKey(k))
+    assert _generate_jit._cache_size() == before
