@@ -143,6 +143,11 @@ def cmd_generate(cfg: Config, prompt: str, max_new_tokens: int,
 
     from .generate import generate as run_generate
 
+    # Cheap argument validation BEFORE the expensive model build/restore.
+    if temperature == 0.0 and (top_k or top_p):
+        raise ValueError(
+            "--top-k/--top-p only apply when sampling — set --temperature"
+        )
     mesh, model, trainer, dataset = build_all(cfg)
     if not hasattr(model, "decode"):
         raise ValueError(
