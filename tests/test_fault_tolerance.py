@@ -194,3 +194,33 @@ def test_two_process_rendezvous():
     l1 = ast.literal_eval(lines[1][len("LOSSES "):])
     np.testing.assert_allclose(l0, l1, rtol=1e-6)
     assert all(np.isfinite(l0))
+    # And the 2-process run must match the SINGLE-process dp=8 run on the
+    # same seeds — per-host sharding is a placement detail, not math.
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.data import (
+        SyntheticTokens,
+        sharded_batches,
+    )
+    from distributeddeeplearning_tpu.train import (
+        Trainer,
+        get_task,
+        make_optimizer,
+    )
+
+    from helpers import mesh_of
+
+    mesh = mesh_of(dp=8)
+    model = models.get_model("gpt2", size="tiny", vocab_size=128, max_len=64)
+    trainer = Trainer(
+        model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh,
+        donate=False,
+    )
+    ds = SyntheticTokens(batch_size=8, seq_len=32, vocab_size=128)
+    state = trainer.init(0, ds.batch(0))
+    oracle = []
+    for i, batch in enumerate(sharded_batches(ds.iter_from(0), mesh)):
+        if i >= 2:
+            break
+        state, metrics = trainer.train_step(state, batch)
+        oracle.append(float(metrics["loss"]))
+    np.testing.assert_allclose(l0, oracle, rtol=1e-5)
