@@ -134,32 +134,59 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# ONE hyperparameter set consumed by both the 2-process worker template
+# and the in-process single-process oracle — copy drift between them would
+# masquerade as a multi-host parity regression.
+_MH = dict(vocab=128, max_len=64, seq=32, batch=8, lr=1e-3, steps=2)
+
+
+def _mh_train_losses(mesh):
+    """The training body both topologies run (same seeds, same data)."""
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.data import (
+        SyntheticTokens,
+        sharded_batches,
+    )
+    from distributeddeeplearning_tpu.train import (
+        Trainer,
+        get_task,
+        make_optimizer,
+    )
+
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=_MH["vocab"], max_len=_MH["max_len"]
+    )
+    trainer = Trainer(
+        model, make_optimizer("adamw", _MH["lr"]), get_task("lm"), mesh,
+        donate=False,
+    )
+    ds = SyntheticTokens(
+        batch_size=_MH["batch"], seq_len=_MH["seq"], vocab_size=_MH["vocab"]
+    )
+    state = trainer.init(0, ds.batch(0))
+    losses = []
+    for i, batch in enumerate(sharded_batches(ds.iter_from(0), mesh)):
+        if i >= _MH["steps"]:
+            break
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
 _WORKER = """
 import sys
 import jax
 from distributeddeeplearning_tpu.mesh import MeshConfig, build_mesh, init_distributed
-from distributeddeeplearning_tpu.data import SyntheticTokens, sharded_batches
-from distributeddeeplearning_tpu import models
-from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
 
 addr, pid = sys.argv[1], int(sys.argv[2])
 assert init_distributed(addr, 2, pid)
 assert jax.process_count() == 2, jax.process_count()
 assert jax.device_count() == 8, jax.device_count()
 
-mesh = build_mesh(MeshConfig(dp=8))
-model = models.get_model("gpt2", size="tiny", vocab_size=128, max_len=64)
-trainer = Trainer(
-    model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh, donate=False
-)
-ds = SyntheticTokens(batch_size=8, seq_len=32, vocab_size=128)
-state = trainer.init(0, ds.batch(0))
-losses = []
-for i, batch in enumerate(sharded_batches(ds.iter_from(0), mesh)):
-    if i >= 2:
-        break
-    state, metrics = trainer.train_step(state, batch)
-    losses.append(float(metrics["loss"]))
+sys.path.insert(0, "tests")
+import test_fault_tolerance as tft
+
+losses = tft._mh_train_losses(build_mesh(MeshConfig(dp=8)))
 print("LOSSES", losses)
 """
 
@@ -196,31 +223,8 @@ def test_two_process_rendezvous():
     assert all(np.isfinite(l0))
     # And the 2-process run must match the SINGLE-process dp=8 run on the
     # same seeds — per-host sharding is a placement detail, not math.
-    from distributeddeeplearning_tpu import models
-    from distributeddeeplearning_tpu.data import (
-        SyntheticTokens,
-        sharded_batches,
-    )
-    from distributeddeeplearning_tpu.train import (
-        Trainer,
-        get_task,
-        make_optimizer,
-    )
-
+    # Both run _mh_train_losses: one definition, no copy drift.
     from helpers import mesh_of
 
-    mesh = mesh_of(dp=8)
-    model = models.get_model("gpt2", size="tiny", vocab_size=128, max_len=64)
-    trainer = Trainer(
-        model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh,
-        donate=False,
-    )
-    ds = SyntheticTokens(batch_size=8, seq_len=32, vocab_size=128)
-    state = trainer.init(0, ds.batch(0))
-    oracle = []
-    for i, batch in enumerate(sharded_batches(ds.iter_from(0), mesh)):
-        if i >= 2:
-            break
-        state, metrics = trainer.train_step(state, batch)
-        oracle.append(float(metrics["loss"]))
+    oracle = _mh_train_losses(mesh_of(dp=8))
     np.testing.assert_allclose(l0, oracle, rtol=1e-5)
