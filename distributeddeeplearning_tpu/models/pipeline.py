@@ -8,10 +8,12 @@ mesh axis) and execution is the SPMD GPipe loop in ``parallel/pp.py``.
 sequentially — identical math, identical init RNG stream — which is the
 parity oracle the pipeline tests compare against.
 
-Embeddings / final LN / LM head live outside the pipeline and are computed
-replicated over ``pp`` (batch is not sharded on ``pp``, so this is redundant
-compute, not extra comms — the standard v1 trade; splitting them into the
-first/last stages is a later optimization).
+Embeddings / final LN / LM head live outside the pipeline loop; their
+COMPUTE is redundant over ``pp`` (batch is not sharded on ``pp``) but their
+STORAGE is not — the embedding/LM-head tables carry the ``vocab_pp``
+logical axis and are sharded over ``(tp, pp)``, so there is no per-pp-rank
+replication tax on the largest tables
+(``tests/test_pipeline.py::test_embedding_sharded_over_pp``).
 """
 
 from __future__ import annotations

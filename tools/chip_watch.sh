@@ -27,7 +27,7 @@ pending_count() {
 }
 
 measure_attempts=0
-for i in $(seq 1 40); do
+for i in $(seq 1 70); do
   if done_yet; then
     echo "all configs measured — done"
     exit 0
