@@ -240,8 +240,12 @@ def port_llama(hf_model):
     p = {
         "embed": {"embedding": sd["model.embed_tokens.weight"]},
         "norm": {"scale": sd["model.norm.weight"]},
-        "lm_head": sd["lm_head.weight"].T,
     }
+    # Tied checkpoints (Llama-3.2-class) have no independent lm_head
+    # tensor; build the model with tie_embeddings=True (validate_params
+    # catches a mismatch — flax would silently ignore an extra lm_head).
+    if not getattr(cfg, "tie_word_embeddings", False):
+        p["lm_head"] = sd["lm_head.weight"].T
     for i in range(n_layers):
         pre = f"model.layers.{i}"
         p[f"block_{i}"] = {
@@ -283,3 +287,37 @@ def port_from_hf(model_name: str, hf_model):
             f"no HF porter for {model_name!r}; have {sorted(PORTERS)}"
         )
     return PORTERS[model_name](hf_model)
+
+
+def validate_params(model, params, example_input=None):
+    """Raise if ``params`` doesn't match ``model``'s own param tree
+    (structure and shapes).
+
+    flax ``apply`` silently IGNORES extra top-level entries — e.g. an
+    untied checkpoint's ``lm_head`` fed into a ``tie_embeddings=True``
+    model decodes through the embedding with no error. Run this after
+    porting:
+
+        params = hf_port.port_from_hf("llama", hf)
+        hf_port.validate_params(model, params)
+    """
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta
+
+    if example_input is None:
+        example_input = jnp.zeros((1, 2), jnp.int32)
+    want = meta.unbox(
+        jax.eval_shape(model.init, jax.random.PRNGKey(0), example_input)
+    ).get("params", {})
+    want_tree = jax.tree.map(jnp.shape, want)
+    got_tree = jax.tree.map(jnp.shape, params)
+    if want_tree != got_tree:
+        missing = set(want_tree) - set(got_tree)
+        extra = set(got_tree) - set(want_tree)
+        raise ValueError(
+            "ported params do not match the model's param tree "
+            f"(missing top-level: {sorted(missing)}, extra: {sorted(extra)}"
+            " — check model kwargs, e.g. tie_embeddings vs the checkpoint's"
+            " tie_word_embeddings)"
+        )

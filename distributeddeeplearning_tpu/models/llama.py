@@ -20,7 +20,8 @@ TPU-first details, consistent with the rest of the zoo:
   and the ring context-parallel cores take the GQA-repeated q/k/v exactly
   like MHA — repeat-then-core is the standard GQA lowering;
 - RMSNorm reduces in fp32 regardless of compute dtype;
-- ``chunked_head=True`` returns hidden + the (untied) lm_head matrix for
+- ``chunked_head=True`` returns hidden + the decoder matrix (the untied
+  lm_head param, or the embedding table when ``tie_embeddings=True``) for
   the chunked cross-entropy (``ops/chunked_xent.py``).
 """
 
@@ -245,19 +246,23 @@ class Llama(nn.Module):
     # KV-cache autoregressive decoding (generate.py): init with the full
     # generation budget to shape the caches, then feed one token per call.
     decode: bool = False
+    # True: the LM head shares the embedding table (Llama-3.2-class small
+    # checkpoints; HF tie_word_embeddings) — no separate lm_head param.
+    tie_embeddings: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         B, L = tokens.shape
         if L > self.max_len:
             raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
-        x = nn.Embed(
+        embed = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype,
             embedding_init=nn.with_logical_partitioning(
                 nn.initializers.normal(0.02), ("vocab", "embed")
             ),
             name="embed",
-        )(tokens)
+        )
+        x = embed(tokens)
         x = constrain(x, "batch", "seq", "embed")
         block = LlamaBlock
         if self.remat == "full":
@@ -273,22 +278,26 @@ class Llama(nn.Module):
                 decode=self.decode, name=f"block_{i}",
             )(x)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
-        # Untied LM head as an explicit param so both head modes share one
-        # param tree (checkpoints/parity stay mode-independent).
-        kernel = self.param(
-            "lm_head",
-            nn.with_logical_partitioning(
-                dense_init(0.02), ("embed", "vocab")
-            ),
-            (self.embed_dim, self.vocab_size),
-        )
-        kernel = jnp.asarray(kernel, self.dtype)
+        if self.tie_embeddings:
+            # Decoder IS the embedding table ([V, E]).
+            decoder_ve = jnp.asarray(embed.embedding, self.dtype)
+        else:
+            # Untied LM head as an explicit param so both head modes share
+            # one param tree (checkpoints/parity stay mode-independent).
+            kernel = self.param(
+                "lm_head",
+                nn.with_logical_partitioning(
+                    dense_init(0.02), ("embed", "vocab")
+                ),
+                (self.embed_dim, self.vocab_size),
+            )
+            decoder_ve = jnp.asarray(kernel, self.dtype).T
         if self.chunked_head:
             from ..ops.chunked_xent import head_output
 
             # chunked_xent wants the decoder as [V, E].
-            return head_output(x, kernel.T)
-        return jnp.einsum("ble,ev->blv", x, kernel).astype(jnp.float32)
+            return head_output(x, decoder_ve)
+        return jnp.einsum("ble,ve->blv", x, decoder_ve).astype(jnp.float32)
 
 
 @register("llama")

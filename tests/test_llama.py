@@ -101,6 +101,13 @@ def test_remat_trains_and_matches(mesh1):
     np.testing.assert_allclose(remat, plain, rtol=1e-5)
 
 
+def test_tied_embeddings_chunked_head_parity(mesh1):
+    # Tied decoder through the chunked cross-entropy == full logits.
+    full = _losses(mesh1, tie_embeddings=True)
+    chunked = _losses(mesh1, tie_embeddings=True, chunked_head=True)
+    np.testing.assert_allclose(chunked, full, rtol=1e-5)
+
+
 def test_gqa_equals_mha_with_repeated_kv_projections():
     # The GQA lowering contract: a kv_heads=2 model must equal a
     # kv_heads=4 (MHA) model whose key/value projections are the GQA
@@ -162,3 +169,62 @@ def test_port_llama_refuses_mlp_bias():
     )
     with pytest.raises(ValueError, match="mlp_bias"):
         port_llama(LlamaForCausalLM(cfg))
+
+
+def test_tied_embeddings_match_hf():
+    # Llama-3.2-class checkpoints tie lm_head to the embedding table; the
+    # port then carries no lm_head tensor and the model decodes through
+    # the embedding. Logits parity + generation through the tied head.
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from distributeddeeplearning_tpu.generate import generate
+    from distributeddeeplearning_tpu.hf_port import port_llama
+
+    torch.manual_seed(3)
+    hf = LlamaForCausalLM(
+        LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=48,
+            rms_norm_eps=1e-6, rope_theta=10000.0,
+            attention_bias=False, tie_word_embeddings=True,
+        )
+    ).eval()
+    params = port_llama(hf)
+    assert "lm_head" not in params
+    model = models.get_model(
+        "llama", size="tiny", vocab_size=128, max_len=48,
+        tie_embeddings=True,
+    )
+    tokens = np.random.default_rng(4).integers(0, 128, (2, 9), np.int32)
+    logits = model.apply({"params": params}, jnp.asarray(tokens))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens, dtype=torch.long)).logits
+    np.testing.assert_allclose(
+        np.asarray(logits), ref.numpy(), atol=2e-4, rtol=1e-4
+    )
+    ours = generate(model, params, tokens[:, :4], max_new_tokens=5)
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor(tokens[:, :4], dtype=torch.long),
+            max_new_tokens=5, do_sample=False, pad_token_id=0,
+        )
+    np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+
+def test_validate_params_catches_tie_mismatch():
+    from distributeddeeplearning_tpu.hf_port import validate_params
+
+    untied = models.get_model("llama", size="tiny", vocab_size=64, max_len=32)
+    tied = models.get_model(
+        "llama", size="tiny", vocab_size=64, max_len=32, tie_embeddings=True
+    )
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    from flax.core import meta
+
+    p_untied = meta.unbox(untied.init(jax.random.PRNGKey(0), tokens))["params"]
+    validate_params(untied, p_untied)  # matching: fine
+    # flax.apply would silently ignore the extra lm_head — this must not.
+    with pytest.raises(ValueError, match="lm_head"):
+        validate_params(tied, p_untied)
