@@ -443,6 +443,8 @@ class PipelinedLlama(nn.Module):
     schedule: str = "gpipe"  # gpipe | 1f1b
     dtype: jnp.dtype = jnp.float32
     mesh: object = None
+    # LM head shares the embedding table (see models/llama.Llama).
+    tie_embeddings: bool = False
 
     def _stage_arch(self) -> dict:
         return dict(
@@ -464,7 +466,7 @@ class PipelinedLlama(nn.Module):
         B, L = tokens.shape
         if L > self.max_len:
             raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
-        x = nn.Embed(
+        embed = nn.Embed(
             self.vocab_size,
             self.embed_dim,
             dtype=self.dtype,
@@ -473,7 +475,8 @@ class PipelinedLlama(nn.Module):
                 nn.initializers.normal(0.02), ("vocab_pp", "embed")
             ),
             name="embed",
-        )(tokens)
+        )
+        x = embed(tokens)
         x = constrain(x, "batch", "seq", "embed")
         x = PipelinedTransformerStack(
             num_layers=self.num_layers,
@@ -486,16 +489,18 @@ class PipelinedLlama(nn.Module):
             **self._stage_arch(),
         )(x, None, not train)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
-        kernel = self.param(
-            "lm_head",
-            nn.with_logical_partitioning(
-                dense_init(0.02), ("embed", "vocab_pp")
-            ),
-            (self.embed_dim, self.vocab_size),
-        )
-        logits = jnp.einsum(
-            "ble,ev->blv", x, jnp.asarray(kernel, self.dtype)
-        )
+        if self.tie_embeddings:
+            decoder_ve = jnp.asarray(embed.embedding, self.dtype)
+        else:
+            kernel = self.param(
+                "lm_head",
+                nn.with_logical_partitioning(
+                    dense_init(0.02), ("embed", "vocab_pp")
+                ),
+                (self.embed_dim, self.vocab_size),
+            )
+            decoder_ve = jnp.asarray(kernel, self.dtype).T
+        logits = jnp.einsum("ble,ve->blv", x, decoder_ve)
         return logits.astype(jnp.float32)
 
     # -- true interleaved 1F1B (schedule='1f1b_interleaved') ---------------
@@ -537,8 +542,16 @@ class PipelinedLlama(nn.Module):
 
         def head_fn(shared, y, bm):
             x = norm_mod.apply({"params": shared["norm"]}, y)
+            if self.tie_embeddings:
+                decoder_ve = jnp.asarray(
+                    shared["embed"]["embedding"], self.dtype
+                )
+            else:
+                decoder_ve = jnp.asarray(
+                    shared["lm_head"], self.dtype
+                ).T
             logits = jnp.einsum(
-                "ble,ev->blv", x, jnp.asarray(shared["lm_head"], self.dtype)
+                "ble,ve->blv", x, decoder_ve
             ).astype(jnp.float32)
             targets = bm["tokens"][:, 1:]
             return optax.softmax_cross_entropy_with_integer_labels(
@@ -546,7 +559,10 @@ class PipelinedLlama(nn.Module):
             ).mean()
 
         stacked = params["h"]["stages"]
-        shared = {k: params[k] for k in ("embed", "norm", "lm_head")}
+        shared_keys = ("embed", "norm") if self.tie_embeddings else (
+            "embed", "norm", "lm_head"
+        )
+        shared = {k: params[k] for k in shared_keys}
         loss, (dstacked, dshared) = interleaved_1f1b(
             embed_fn, stage_fn, head_fn, stacked, shared,
             {"tokens": batch["tokens"]},

@@ -269,7 +269,7 @@ class TestInterleaved1F1B:
 
 def _train_losses(
     mesh, pipeline, steps=3, grad_accum=1, zero1=False, num_stages=4,
-    schedule="gpipe", model_name="gpt2_pp",
+    schedule="gpipe", model_name="gpt2_pp", **model_kwargs,
 ):
     model = models.get_model(
         model_name,
@@ -281,6 +281,7 @@ def _train_losses(
         pipeline=pipeline,
         schedule=schedule,
         mesh=mesh if pipeline else None,
+        **model_kwargs,
     )
     trainer = Trainer(
         model,
@@ -451,3 +452,16 @@ class TestPipelinedLlama:
             schedule="1f1b_interleaved", model_name="llama_pp",
         )
         np.testing.assert_allclose(ref, inter, rtol=2e-5)
+
+
+def test_llama_pp_tied_embeddings_parity(mesh1, mesh_factory):
+    # Tied decoder through the pipelined stack, all three schedules vs the
+    # sequential oracle (shared _train_losses harness).
+    ref = _train_losses(mesh1, pipeline=False, model_name="llama_pp",
+                        tie_embeddings=True)
+    for schedule in ("gpipe", "1f1b", "1f1b_interleaved"):
+        pp = _train_losses(
+            mesh_factory(dp=2, pp=4), pipeline=True, schedule=schedule,
+            model_name="llama_pp", tie_embeddings=True,
+        )
+        np.testing.assert_allclose(ref, pp, rtol=2e-5, err_msg=schedule)
