@@ -1,0 +1,44 @@
+"""Mixtral-class workload: Llama backbone with routed SwiGLU experts.
+
+Beyond the reference's workload list: every block's MLP is a top-2-of-8
+expert layer sharded over the ``ep`` mesh axis (``models/moe.LlamaMoe``),
+on the GQA/RoPE/RMSNorm backbone of ``configs/llama_lm.py``.
+
+Run (8-device CPU sim): ``python -m distributeddeeplearning_tpu.cli train
+--config configs/llama_moe.py --override mesh.ep=4 --override mesh.dp=2``.
+"""
+
+from distributeddeeplearning_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(
+            name="llama_moe",
+            kwargs={
+                "size": "8x300m",
+                "max_len": 2048,
+                "num_experts": 8,
+                "num_selected": 2,
+                "chunked_head": True,
+                "dtype": "bfloat16",
+            },
+        ),
+        data=DataConfig(
+            kind="synthetic_tokens", batch_size=16, seq_len=2048,
+            vocab_size=32000,
+        ),
+        optim=OptimConfig(
+            name="adamw", lr=3e-4, b2=0.95, weight_decay=0.1,
+            schedule="cosine", warmup_steps=200, grad_clip=1.0,
+        ),
+        train=TrainConfig(steps=1000, log_every=20, task="lm", zero1=True),
+        mesh=MeshConfig(dp=-1, ep=1),
+    )

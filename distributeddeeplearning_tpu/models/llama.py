@@ -196,6 +196,21 @@ class LlamaMlp(nn.Module):
         return out
 
 
+def decoder_matrix(module, embed, *, tie: bool, embed_dim: int,
+                   vocab_size: int, dtype, vocab_axis: str = "vocab"):
+    """THE LM-head decoder resolver, [V, E]: the tied embedding table, or
+    an untied ``lm_head`` param created on ``module``. One definition for
+    Llama, LlamaMoe, and PipelinedLlama so the head cannot drift."""
+    if tie:
+        return jnp.asarray(embed.embedding, dtype)
+    kernel = module.param(
+        "lm_head",
+        nn.with_logical_partitioning(dense_init(0.02), ("embed", vocab_axis)),
+        (embed_dim, vocab_size),
+    )
+    return jnp.asarray(kernel, dtype).T
+
+
 class LlamaBlock(nn.Module):
     num_heads: int
     num_kv_heads: int
@@ -278,20 +293,11 @@ class Llama(nn.Module):
                 decode=self.decode, name=f"block_{i}",
             )(x)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
-        if self.tie_embeddings:
-            # Decoder IS the embedding table ([V, E]).
-            decoder_ve = jnp.asarray(embed.embedding, self.dtype)
-        else:
-            # Untied LM head as an explicit param so both head modes share
-            # one param tree (checkpoints/parity stay mode-independent).
-            kernel = self.param(
-                "lm_head",
-                nn.with_logical_partitioning(
-                    dense_init(0.02), ("embed", "vocab")
-                ),
-                (self.embed_dim, self.vocab_size),
-            )
-            decoder_ve = jnp.asarray(kernel, self.dtype).T
+        decoder_ve = decoder_matrix(
+            self, embed, tie=self.tie_embeddings,
+            embed_dim=self.embed_dim, vocab_size=self.vocab_size,
+            dtype=self.dtype,
+        )
         if self.chunked_head:
             from ..ops.chunked_xent import head_output
 

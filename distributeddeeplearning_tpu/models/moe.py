@@ -46,6 +46,9 @@ class MoeMlp(nn.Module):
     num_selected: int = 2
     capacity_factor: float = 1.25
     activation: str = "gelu_exact"
+    # 'gelu' = two-matrix GELU experts (GPT-2-shaped, biased);
+    # 'swiglu' = three-matrix gated experts, bias-free (Mixtral-shaped).
+    mlp_style: str = "gelu"
     aux_loss_weight: float = 1e-2
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
@@ -55,7 +58,6 @@ class MoeMlp(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         g, t, d = x.shape
         e = self.num_experts
-        act = {"gelu_exact": gelu_exact, "gelu_tanh": gelu_tanh}[self.activation]
 
         # Router runs in fp32 regardless of compute dtype (small matmul,
         # numerically load-bearing).
@@ -82,46 +84,59 @@ class MoeMlp(nn.Module):
         expert_in = jnp.einsum("gtec,gtd->egcd", dispatch.astype(x.dtype), x)
         expert_in = constrain(expert_in, "expert", "batch", None, "embed")
 
-        w1 = self.param(
-            "w1",
-            nn.with_logical_partitioning(
-                dense_init(self.init_scale), ("expert", "embed", "mlp")
-            ),
-            (e, d, self.hidden_dim),
-            self.dtype,
-        )
-        b1 = self.param(
-            "b1",
-            nn.with_logical_partitioning(
-                nn.initializers.zeros, ("expert", "mlp")
-            ),
-            (e, self.hidden_dim),
-            self.dtype,
-        )
-        w2 = self.param(
-            "w2",
-            nn.with_logical_partitioning(
-                dense_init(self.init_scale), ("expert", "mlp", "embed")
-            ),
-            (e, self.hidden_dim, d),
-            self.dtype,
-        )
-        b2 = self.param(
-            "b2",
-            nn.with_logical_partitioning(
-                nn.initializers.zeros, ("expert", "embed")
-            ),
-            (e, d),
-            self.dtype,
-        )
-        h = act(
-            jnp.einsum("egcd,edh->egch", expert_in, w1.astype(x.dtype))
-            + b1.astype(x.dtype)[:, None, None, :]
-        )
-        out = (
-            jnp.einsum("egch,ehd->egcd", h, w2.astype(x.dtype))
-            + b2.astype(x.dtype)[:, None, None, :]
-        )
+        def ew(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    dense_init(self.init_scale), axes
+                ),
+                shape,
+                self.dtype,
+            )
+
+        w1 = ew("w1", (e, d, self.hidden_dim), ("expert", "embed", "mlp"))
+        w2 = ew("w2", (e, self.hidden_dim, d), ("expert", "mlp", "embed"))
+        if self.mlp_style == "swiglu":
+            # Mixtral-shaped experts: silu(x@w_gate) * (x@w1) @ w2, no
+            # biases — the per-expert SwiGLU of models/llama.LlamaMlp.
+            wg = ew(
+                "w_gate", (e, d, self.hidden_dim), ("expert", "embed", "mlp")
+            )
+            h = nn.silu(
+                jnp.einsum("egcd,edh->egch", expert_in, wg.astype(x.dtype))
+            ) * jnp.einsum("egcd,edh->egch", expert_in, w1.astype(x.dtype))
+            out = jnp.einsum("egch,ehd->egcd", h, w2.astype(x.dtype))
+        elif self.mlp_style == "gelu":
+            # activation applies to this style only (swiglu is gated silu).
+            act = {
+                "gelu_exact": gelu_exact, "gelu_tanh": gelu_tanh,
+            }[self.activation]
+            b1 = self.param(
+                "b1",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("expert", "mlp")
+                ),
+                (e, self.hidden_dim),
+                self.dtype,
+            )
+            b2 = self.param(
+                "b2",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("expert", "embed")
+                ),
+                (e, d),
+                self.dtype,
+            )
+            h = act(
+                jnp.einsum("egcd,edh->egch", expert_in, w1.astype(x.dtype))
+                + b1.astype(x.dtype)[:, None, None, :]
+            )
+            out = (
+                jnp.einsum("egch,ehd->egcd", h, w2.astype(x.dtype))
+                + b2.astype(x.dtype)[:, None, None, :]
+            )
+        else:
+            raise ValueError(f"unknown mlp_style {self.mlp_style!r}")
         out = constrain(out, "expert", "batch", None, "embed")
         # Gather back to token order; dropped tokens contribute zero (the
         # residual connection around the block carries them through).
@@ -268,3 +283,130 @@ def gpt2_moe(size: str = "tiny", **kwargs):
     defaults = dict(num_layers=n_l, num_heads=n_h, embed_dim=d)
     defaults.update(kwargs)
     return MoeGPT2(**defaults)
+
+
+class LlamaMoeBlock(nn.Module):
+    """Mixtral-shaped block: RMSNorm → GQA attention → RMSNorm → routed
+    SwiGLU experts (every layer — Mixtral routes all blocks)."""
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    mlp_dim: int
+    num_experts: int
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"  # same options as LlamaAttention
+    mesh: object = None  # required for the ring attn_impl variants
+
+    @nn.compact
+    def __call__(self, x):
+        from .llama import LlamaAttention, RMSNorm
+
+        x = x + LlamaAttention(
+            self.num_heads, self.num_kv_heads, self.head_dim,
+            rope_theta=self.rope_theta, dtype=self.dtype,
+            attn_impl=self.attn_impl, mesh=self.mesh, name="attn",
+        )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
+        x = constrain(x, "batch", "seq", "embed")
+        x = x + MoeMlp(
+            self.num_experts,
+            self.mlp_dim,
+            num_selected=self.num_selected,
+            capacity_factor=self.capacity_factor,
+            mlp_style="swiglu",
+            dtype=self.dtype,
+            name="moe_mlp",
+        )(RMSNorm(self.rms_eps, self.dtype, name="mlp_norm")(x))
+        return constrain(x, "batch", "seq", "embed")
+
+
+class LlamaMoe(nn.Module):
+    """Mixtral-class decoder: Llama backbone (RoPE, RMSNorm, GQA), every
+    MLP a top-k routed SwiGLU expert layer over the ``ep`` mesh axis."""
+
+    vocab_size: int = 32000
+    max_len: int = 4096
+    num_layers: int = 8
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    embed_dim: int = 512
+    mlp_dim: int = 1408
+    num_experts: int = 8
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    remat: str = "none"
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+    mesh: object = None
+    chunked_head: bool = False
+    tie_embeddings: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        from .llama import RMSNorm
+
+        B, L = tokens.shape
+        if L > self.max_len:
+            raise ValueError(f"seq_len {L} exceeds max_len {self.max_len}")
+        embed = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )
+        x = embed(tokens)
+        x = constrain(x, "batch", "seq", "embed")
+        block = LlamaMoeBlock
+        if self.remat == "full":
+            block = nn.remat(LlamaMoeBlock)
+        elif self.remat != "none":
+            raise ValueError(f"unknown remat {self.remat!r}")
+        for i in range(self.num_layers):
+            x = block(
+                self.num_heads, self.num_kv_heads,
+                self.embed_dim // self.num_heads, self.mlp_dim,
+                num_experts=self.num_experts,
+                num_selected=self.num_selected,
+                capacity_factor=self.capacity_factor,
+                rope_theta=self.rope_theta, rms_eps=self.rms_eps,
+                dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
+                name=f"block_{i}",
+            )(x)
+        x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
+        from .llama import decoder_matrix
+
+        decoder_ve = decoder_matrix(
+            self, embed, tie=self.tie_embeddings,
+            embed_dim=self.embed_dim, vocab_size=self.vocab_size,
+            dtype=self.dtype,
+        )
+        if self.chunked_head:
+            from ..ops.chunked_xent import head_output
+
+            return head_output(x, decoder_ve)
+        return jnp.einsum(
+            "ble,ve->blv", x, decoder_ve
+        ).astype(jnp.float32)
+
+
+@register("llama_moe")
+def llama_moe(size: str = "tiny", **kwargs):
+    sizes = {
+        # (layers, heads, kv_heads, embed, mlp)
+        "tiny": (2, 4, 2, 64, 128),
+        "8x300m": (12, 16, 8, 1024, 2816),
+    }
+    n_l, n_h, n_kv, d, m = sizes[size]
+    defaults = dict(
+        num_layers=n_l, num_heads=n_h, num_kv_heads=n_kv,
+        embed_dim=d, mlp_dim=m,
+    )
+    defaults.update(kwargs)
+    return LlamaMoe(**defaults)

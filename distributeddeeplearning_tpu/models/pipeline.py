@@ -489,17 +489,13 @@ class PipelinedLlama(nn.Module):
             **self._stage_arch(),
         )(x, None, not train)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
-        if self.tie_embeddings:
-            decoder_ve = jnp.asarray(embed.embedding, self.dtype)
-        else:
-            kernel = self.param(
-                "lm_head",
-                nn.with_logical_partitioning(
-                    dense_init(0.02), ("embed", "vocab_pp")
-                ),
-                (self.embed_dim, self.vocab_size),
-            )
-            decoder_ve = jnp.asarray(kernel, self.dtype).T
+        from .llama import decoder_matrix
+
+        decoder_ve = decoder_matrix(
+            self, embed, tie=self.tie_embeddings,
+            embed_dim=self.embed_dim, vocab_size=self.vocab_size,
+            dtype=self.dtype, vocab_axis="vocab_pp",
+        )
         logits = jnp.einsum("ble,ve->blv", x, decoder_ve)
         return logits.astype(jnp.float32)
 
