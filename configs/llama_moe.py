@@ -27,6 +27,7 @@ def get_config() -> Config:
                 "max_len": 2048,
                 "num_experts": 8,
                 "num_selected": 2,
+                "attn_impl": "flash",
                 "chunked_head": True,
                 "dtype": "bfloat16",
             },

@@ -461,7 +461,6 @@ class PipelinedLlama(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         from .llama import RMSNorm
-        from .transformer import dense_init
 
         B, L = tokens.shape
         if L > self.max_len:
