@@ -14,9 +14,11 @@ full-prefix recompute).
     tokens = generate(model, params, prompt, max_new_tokens=32)   # greedy
     tokens = generate(..., temperature=0.8, rng=jax.random.PRNGKey(0))
 
-``model`` must support ``decode=True`` (GPT-2 and Llama do; their fused
-kernels are a training feature — decoding runs the xla core, so pass a
-model with ``attn_impl='xla'``).
+``model`` must support ``decode=True`` (GPT-2, Llama, and the Mixtral-class
+llama_moe do; fused attention kernels are a training feature — decoding
+runs the xla core, so pass a model with ``attn_impl='xla'``). Capacity-MoE
+models never drop tokens during one-token decode steps, so their decode can
+differ slightly from the batched training forward when capacity binds.
 """
 
 from __future__ import annotations

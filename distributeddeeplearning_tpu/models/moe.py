@@ -301,6 +301,11 @@ class LlamaMoeBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "xla"  # same options as LlamaAttention
     mesh: object = None  # required for the ring attn_impl variants
+    # KV-cache decoding. NOTE: decode steps route ONE token, so they never
+    # hit the capacity limit — a trained model whose batched forward drops
+    # tokens will decode slightly differently (no drops at inference, the
+    # standard capacity-MoE asymmetry).
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -309,7 +314,8 @@ class LlamaMoeBlock(nn.Module):
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.head_dim,
             rope_theta=self.rope_theta, dtype=self.dtype,
-            attn_impl=self.attn_impl, mesh=self.mesh, name="attn",
+            attn_impl=self.attn_impl, mesh=self.mesh, decode=self.decode,
+            name="attn",
         )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
         x = constrain(x, "batch", "seq", "embed")
         x = x + MoeMlp(
@@ -346,6 +352,7 @@ class LlamaMoe(nn.Module):
     mesh: object = None
     chunked_head: bool = False
     tie_embeddings: bool = False
+    decode: bool = False  # KV-cache decoding (generate.py)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -377,7 +384,7 @@ class LlamaMoe(nn.Module):
                 capacity_factor=self.capacity_factor,
                 rope_theta=self.rope_theta, rms_eps=self.rms_eps,
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
-                name=f"block_{i}",
+                decode=self.decode, name=f"block_{i}",
             )(x)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
         from .llama import decoder_matrix
