@@ -79,6 +79,11 @@ ZOO = {
         # binding constraint for tp divisibility.
         heads=4, mlp=128, embed=64, vocab=256, kv_heads=2,
     ),
+    "llama_moe": dict(
+        kwargs=dict(size="tiny", vocab_size=256, max_len=64, num_experts=8),
+        example=lambda: jnp.zeros((4, 16), jnp.int32),
+        heads=4, mlp=128, embed=64, vocab=256, kv_heads=2, experts=8,
+    ),
 }
 
 _SPEC_CACHE: dict[str, object] = {}
