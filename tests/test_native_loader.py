@@ -199,3 +199,23 @@ def test_native_augmentation_matches_numpy(tmp_path, monkeypatch):
     )
     assert np.abs(native_ds.batch(0)["image"]
                   - plain.batch(0)["image"]).max() > 0
+
+
+@needs_native
+def test_native_augmentation_hwc_layout(tmp_path, monkeypatch):
+    """The C++ augment gather handles the pixel-major (hwc) payload layout
+    identically to the numpy path (chw is covered above)."""
+    from distributeddeeplearning_tpu.native import loader as loader_mod
+
+    path = str(tmp_path / "train.bin")
+    _write_records(path, n=24, size=8)
+    kw = dict(path=path, batch_size=8, image_size=8, shuffle=True, seed=3,
+              augment=True, aug_pad=2, layout="hwc")
+    native_ds = RecordFileImages(**kw)
+    monkeypatch.setattr(loader_mod, "_lib", lambda: None)
+    fallback_ds = RecordFileImages(**kw)
+    assert native_ds._h is not None and fallback_ds._h is None
+    for i in (0, 2, 4):
+        a, b = native_ds.batch(i), fallback_ds.batch(i)
+        np.testing.assert_array_equal(a["label"], b["label"], err_msg=str(i))
+        np.testing.assert_array_equal(a["image"], b["image"], err_msg=str(i))
