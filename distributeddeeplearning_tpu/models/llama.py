@@ -83,7 +83,8 @@ class LlamaAttention(nn.Module):
     head_dim: int
     rope_theta: float = 10000.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"  # xla | flash | ring | ring_pallas
+    # xla | flash | ring | ring_pallas | ulysses | ulysses_flash
+    attn_impl: str = "xla"
     mesh: object = None  # required for the ring variants
     # Manual tensor parallelism (inside an explicit shard_map, e.g. PP×TP):
     # the module then sees tp-LOCAL head counts and psums the row-parallel
@@ -142,6 +143,16 @@ class LlamaAttention(nn.Module):
             out = decode_attention(
                 self, q, k, v, dtype=self.dtype, attn_impl=self.attn_impl,
                 idx_var=idx_var,
+            )
+        elif self.attn_impl in ("ulysses", "ulysses_flash"):
+            # Sequence<->heads all-to-all reshard around an MHA core
+            # (GQA already repeated above, so head counts match q).
+            from ..parallel.sp_ulysses import ulysses_attention
+
+            out = ulysses_attention(
+                q, k, v, flash=self.attn_impl == "ulysses_flash",
+                causal=True, dtype=self.dtype, mesh=self.mesh,
+                num_heads=self.num_heads,
             )
         else:
             out = attention_core(

@@ -248,49 +248,35 @@ class SelfAttention(nn.Module):
                 q, k, v, impl=self.attn_impl, causal=self.causal,
                 dtype=self.dtype, mesh=self.mesh,
             )
+        elif self.attn_impl in ("ulysses", "ulysses_flash"):
+            flash = self.attn_impl == "ulysses_flash"
+            if flash and (
+                mask is not None or (self.dropout_rate and not deterministic)
+            ):
+                raise NotImplementedError(
+                    "ulysses_flash supports mask=None and no active "
+                    "attention-dropout"
+                )
+            from ..parallel.sp_ulysses import ulysses_attention
+
+            out = ulysses_attention(
+                q, k, v, flash=flash, causal=self.causal, dtype=self.dtype,
+                mesh=self.mesh, num_heads=self.num_heads,
+                mask=None if flash else mask,
+                dropout=None if flash else nn.Dropout(
+                    self.dropout_rate, deterministic=deterministic
+                ),
+            )
+        elif self.attn_impl == "xla":
+            out = attention_core(
+                q, k, v, impl="xla", causal=self.causal,
+                dtype=self.dtype, mask=mask,
+                dropout=nn.Dropout(
+                    self.dropout_rate, deterministic=deterministic
+                ),
+            )
         else:
-            if self.attn_impl in ("ulysses", "ulysses_flash"):
-                if self.mesh is not None:
-                    from ..parallel.sp_ulysses import check_ulysses_shapes
-
-                    check_ulysses_shapes(
-                        self.num_heads,
-                        q.shape[1],
-                        self.mesh.shape["tp"],
-                        self.mesh.shape["cp"],
-                    )
-                # Reshard seq->heads for the attention core; the inverse
-                # constraint below restores the seq-sharded layout.
-                from ..parallel.sp_ulysses import ulysses_reshard
-
-                q, k, v = ulysses_reshard(q, k, v)
-            elif self.attn_impl != "xla":
-                raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
-            if self.attn_impl == "ulysses_flash":
-                if mask is not None or (
-                    self.dropout_rate and not deterministic
-                ):
-                    raise NotImplementedError(
-                        "ulysses_flash supports mask=None and no active "
-                        "attention-dropout"
-                    )
-                # Interior layout: seq gathered, heads over (tp, cp).
-                out = attention_core(
-                    q, k, v, impl="flash", causal=self.causal,
-                    dtype=self.dtype, head_axes=("tp", "cp"),
-                )
-            else:
-                out = attention_core(
-                    q, k, v, impl="xla", causal=self.causal,
-                    dtype=self.dtype, mask=mask,
-                    dropout=nn.Dropout(
-                        self.dropout_rate, deterministic=deterministic
-                    ),
-                )
-            if self.attn_impl in ("ulysses", "ulysses_flash"):
-                from ..parallel.sp_ulysses import ulysses_restore
-
-                out = ulysses_restore(out)
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         out = nn.DenseGeneral(
             features=features,
             axis=(-2, -1),

@@ -53,3 +53,33 @@ def ulysses_restore(out):
     return constrain(out, "batch", "seq", "heads", "kv")
 
 
+
+
+def ulysses_attention(q, k, v, *, flash: bool, causal: bool, dtype,
+                      mesh=None, num_heads: int | None = None,
+                      mask=None, dropout=None):
+    """The full Ulysses wrap in ONE place (shape check → reshard → core →
+    restore), shared by ``transformer.SelfAttention`` and
+    ``models/llama.LlamaAttention`` so the reshard recipe cannot drift.
+
+    ``flash`` picks the fused kernel core (heads sharded over ('tp','cp')
+    inside) vs the xla core (which alone takes ``mask``/``dropout`` —
+    callers gate those for flash loudly)."""
+    from ..models.transformer import attention_core
+
+    if mesh is not None and num_heads is not None:
+        check_ulysses_shapes(
+            num_heads, q.shape[1], mesh.shape["tp"], mesh.shape["cp"]
+        )
+    q, k, v = ulysses_reshard(q, k, v)
+    if flash:
+        out = attention_core(
+            q, k, v, impl="flash", causal=causal, dtype=dtype,
+            head_axes=("tp", "cp"),
+        )
+    else:
+        out = attention_core(
+            q, k, v, impl="xla", causal=causal, dtype=dtype,
+            mask=mask, dropout=dropout,
+        )
+    return ulysses_restore(out)

@@ -28,9 +28,14 @@ def compiled_step_text(mesh, model_name="gpt2", attn_impl="xla", rules=None,
     kwargs = dict(size="tiny", vocab_size=64, max_len=32, dropout_rate=0.0)
     if model_name == "llama":
         del kwargs["dropout_rate"]  # the Llama module has no dropout knob
-    if model_name == "gpt2":
+    if model_name in ("gpt2", "llama"):
         kwargs["attn_impl"] = attn_impl
-        kwargs["mesh"] = mesh if attn_impl in ("ring", "ring_pallas") else None
+        kwargs["mesh"] = (
+            mesh
+            if attn_impl in ("ring", "ring_pallas", "ulysses",
+                             "ulysses_flash")
+            else None
+        )
     kwargs.update(model_kwargs)
     model = models.get_model(model_name, **kwargs)
     ds = data_lib.SyntheticTokens(
@@ -47,10 +52,15 @@ def compiled_step_text(mesh, model_name="gpt2", attn_impl="xla", rules=None,
     return trainer.train_step.lower(state, batch).compile().as_text()
 
 
-def test_ulysses_emits_all_to_all():
+@pytest.mark.parametrize("model_name", ["gpt2", "llama"])
+def test_ulysses_emits_all_to_all(model_name):
     mesh = mesh_of(dp=2, cp=4)
-    control = collective_counts(compiled_step_text(mesh, attn_impl="xla"))
-    ulysses = collective_counts(compiled_step_text(mesh, attn_impl="ulysses"))
+    control = collective_counts(
+        compiled_step_text(mesh, model_name=model_name, attn_impl="xla")
+    )
+    ulysses = collective_counts(
+        compiled_step_text(mesh, model_name=model_name, attn_impl="ulysses")
+    )
     # The xla core on the same mesh performs no seq<->heads flip at all.
     assert control["all-to-all"] == 0, control
     assert ulysses["all-to-all"] > 0, ulysses

@@ -52,7 +52,9 @@ def test_llama_matches_hf():
 
 
 def _losses(mesh, steps=3, **model_kw):
-    if model_kw.get("attn_impl") in ("ring", "ring_pallas"):
+    if model_kw.get("attn_impl") in (
+        "ring", "ring_pallas", "ulysses", "ulysses_flash"
+    ):
         model_kw.setdefault("mesh", mesh)
     model = _tiny(**model_kw)
     trainer = Trainer(
@@ -228,3 +230,12 @@ def test_validate_params_catches_tie_mismatch():
     # flax.apply would silently ignore the extra lm_head — this must not.
     with pytest.raises(ValueError, match="lm_head"):
         validate_params(tied, p_untied)
+
+
+@pytest.mark.parametrize("impl", ["ulysses", "ulysses_flash"])
+def test_ulysses_on_cp_mesh_matches_single_device(mesh1, impl):
+    # Sequence<->heads all-to-all reshard with GQA-repeated heads: the
+    # cp-sharded run must reproduce single-device training.
+    single = _losses(mesh1)
+    uly = _losses(mesh_of(dp=2, cp=2), attn_impl=impl)
+    np.testing.assert_allclose(uly, single, rtol=2e-4)
