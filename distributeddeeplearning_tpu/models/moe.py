@@ -161,6 +161,8 @@ class MoeTransformerBlock(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     init_scale: float = 0.02
+    attn_impl: str = "xla"  # same options as SelfAttention
+    mesh: object = None  # required for the ring attn_impl variants
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -171,6 +173,8 @@ class MoeTransformerBlock(nn.Module):
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
             init_scale=self.init_scale,
+            attn_impl=self.attn_impl,
+            mesh=self.mesh,
             name="attn",
         )
         drop = nn.Dropout(self.dropout_rate, deterministic=deterministic)
@@ -204,6 +208,8 @@ class MoeGPT2(nn.Module):
     moe_every: int = 2
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"  # same options as SelfAttention
+    mesh: object = None  # required for the ring attn_impl variants
     # True: return hidden states + tied decoder for the tasks' chunked
     # cross-entropy instead of [B, L, V] logits (ops/chunked_xent.py).
     chunked_head: bool = False
@@ -249,6 +255,8 @@ class MoeGPT2(nn.Module):
                     activation="gelu_tanh",
                     dropout_rate=self.dropout_rate,
                     dtype=self.dtype,
+                    attn_impl=self.attn_impl,
+                    mesh=self.mesh,
                     name=f"block_{i}",
                 )(x, None, not train)
             else:
@@ -262,6 +270,8 @@ class MoeGPT2(nn.Module):
                     ln_eps=1e-5,
                     dropout_rate=self.dropout_rate,
                     dtype=self.dtype,
+                    attn_impl=self.attn_impl,
+                    mesh=self.mesh,
                     name=f"block_{i}",
                 )(x, None, not train)
         x = layer_norm(1e-5, self.dtype, "ln_f")(x)

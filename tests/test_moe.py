@@ -231,3 +231,9 @@ def test_llama_moe_config_trains_via_cli(capsys):
     assert cmd_train(cfg) == 0
     out = capsys.readouterr().out
     assert "'ep': 2" in out and "aux_loss" in out
+
+
+def test_gpt2_moe_flash_core_matches_xla(mesh1):
+    xla, _ = _train_losses(mesh1, attn_impl="xla")
+    flash, _ = _train_losses(mesh1, attn_impl="flash")
+    np.testing.assert_allclose(flash, xla, rtol=2e-4)
