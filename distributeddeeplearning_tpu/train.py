@@ -470,17 +470,51 @@ class Trainer:
         """schedule='1f1b_interleaved': the pipeline engine computes loss AND
         grads inside one schedule (parallel/pp.interleaved_1f1b), so the step
         skips ``jax.value_and_grad`` entirely; the optimizer update is
-        unchanged (incl. the fused/ZeRO shard_map dispatch)."""
-        if self.grad_accum != 1:
-            raise NotImplementedError(
-                "grad_accum composes with schedule='gpipe'/'1f1b'; the "
-                "interleaved engine already microbatches internally"
+        unchanged (incl. the fused/ZeRO shard_map dispatch).
+
+        ``grad_accum > 1`` composes as an outer on-device scan over microbatch
+        GROUPS: the batch splits into ``grad_accum`` groups, each group runs
+        one full interleaved schedule (its own ``num_microbatches`` pipeline
+        microbatches), and fp32 grads accumulate across groups — exactly the
+        grad-accum semantics of the non-pipelined path (each group's
+        loss/grads are means over its examples; the group-mean equals the
+        whole-batch mean since groups are equal-sized). This keeps the
+        reference's DP+accumulation workload (BASELINE.json:9) runnable under
+        the framework's best pipeline schedule."""
+
+        def one_group(params, group_batch):
+            return self.model.pipeline_value_and_grad(
+                params, group_batch, self.mesh
             )
 
         def step_fn(state: TrainState, batch):
-            loss, grads = self.model.pipeline_value_and_grad(
-                state.params, batch, self.mesh
-            )
+            if self.grad_accum > 1:
+                groups = jax.tree.map(
+                    lambda x: x.reshape(
+                        (self.grad_accum, x.shape[0] // self.grad_accum)
+                        + x.shape[1:]
+                    ),
+                    batch,
+                )
+
+                def micro(carry, group_batch):
+                    loss_acc, grads_acc = carry
+                    loss, grads = one_group(state.params, group_batch)
+                    grads_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                    )
+                    return (loss_acc + loss, grads_acc), None
+
+                zeros = jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), state.params
+                )
+                (loss, grads), _ = jax.lax.scan(
+                    micro, (jnp.zeros((), jnp.float32), zeros), groups
+                )
+                loss = loss / self.grad_accum
+                grads = jax.tree.map(lambda g: g / self.grad_accum, grads)
+            else:
+                loss, grads = one_group(state.params, batch)
             updates_tx, new_opt_state = self._tx_update(
                 grads, state.opt_state, state.params
             )

@@ -219,6 +219,18 @@ class TestInterleaved1F1B:
         )
         np.testing.assert_allclose(ref, inter, rtol=2e-5)
 
+    def test_grad_accum_composes(self, mesh1, mesh_factory):
+        # VERDICT r3 #4: the reference's DP+accumulation workload
+        # (BASELINE.json:9) must be runnable under the framework's best
+        # pipeline schedule — grad_accum is an outer scan over microbatch
+        # groups, each group one full interleaved schedule.
+        ref = _train_losses(mesh1, pipeline=False, grad_accum=2)
+        inter = _train_losses(
+            mesh_factory(dp=2, pp=4), pipeline=True, grad_accum=2,
+            zero1=True, schedule="1f1b_interleaved",
+        )
+        np.testing.assert_allclose(ref, inter, rtol=2e-5)
+
     def test_stash_bounded_by_pipeline_depth(self):
         # The schedule's defining property: for M >> S the interleaved
         # engine holds at most 2S microbatch activations; the custom_vjp

@@ -2,13 +2,24 @@
 # The attached TPU intermittently wedges at backend init (see BASELINE.md's
 # chip-health log). This watcher probes every 10 minutes and, while the chip
 # is up, runs tools/measure_tpu.py to populate TPU_NUMBERS.json with the
-# per-config real-chip measurements BASELINE.md's table is waiting on.
+# per-config real-chip measurements BASELINE.md's table is waiting on
+# (kernel-exercising configs first; the Pallas smoke tier runs at the top of
+# each healthy window — see measure_tpu.py's module docstring).
 # measure_tpu.py resumes incrementally (skips configs already measured), so
 # a mid-measure wedge just means the next healthy probe picks up where it
 # left off. The loop ends once every config has an error-free record.
 #
 #   nohup tools/chip_watch.sh > /tmp/chip_watch.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
+
+MAX_PROBES=70           # ~12h of 10-minute wedge probes
+MAX_STALLED_ATTEMPTS=5  # consecutive no-progress measurement attempts
+# measure_tpu.py paces itself against DDL_MEASURE_BUDGET (graceful, reaps its
+# own subprocess groups); the outer timeout is a pure backstop for an
+# in-process wedge-hang and is deliberately larger so its SIGTERM can't land
+# while the smoke tier's subprocess tree is alive (orphan would hold the chip).
+export DDL_MEASURE_BUDGET=3600
+MEASURE_BACKSTOP=4500
 
 # Completion lives in measure_tpu.py itself (--check): one source of truth
 # for the config list and record validity (incl. config fingerprints).
@@ -17,30 +28,30 @@ done_yet() {
 }
 
 # Separate budgets: wedge probes are cheap (2 min), measurement attempts
-# are not (up to 40 min) — a deterministically-failing config must not
-# hammer the shared chip for days. An attempt that makes progress (fewer
+# are not (up to $DDL_MEASURE_BUDGET) — a deterministically-failing config
+# must not hammer the shared chip for days. An attempt that makes progress (fewer
 # pending configs after than before) resets the budget, so mid-measure
-# wedges keep being ridden out across all 40 probes.
+# wedges keep being ridden out across all $MAX_PROBES probes.
 pending_count() {
   python tools/measure_tpu.py --check 2>/dev/null \
     | sed -n 's/^pending: //p' | wc -w
 }
 
 measure_attempts=0
-for i in $(seq 1 70); do
+for i in $(seq 1 "$MAX_PROBES"); do
   if done_yet; then
     echo "all configs measured — done"
     exit 0
   fi
-  if [ "$measure_attempts" -ge 5 ]; then
-    echo "5 no-progress measurement attempts exhausted — giving up"
+  if [ "$measure_attempts" -ge "$MAX_STALLED_ATTEMPTS" ]; then
+    echo "$MAX_STALLED_ATTEMPTS no-progress measurement attempts exhausted — giving up"
     exit 1
   fi
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     measure_attempts=$((measure_attempts + 1))
     before=$(pending_count)
     echo "probe $i: chip alive — measuring (attempt $measure_attempts, $before pending)"
-    timeout 2400 python tools/measure_tpu.py
+    timeout "$MEASURE_BACKSTOP" python tools/measure_tpu.py
     after=$(pending_count)
     if [ "$after" -lt "$before" ]; then
       measure_attempts=0  # progress: keep riding out mid-measure wedges
@@ -55,5 +66,5 @@ if done_yet; then
   echo "all configs measured — done"
   exit 0
 fi
-echo "gave up after 40 probes"
+echo "gave up after $MAX_PROBES probes"
 exit 1
