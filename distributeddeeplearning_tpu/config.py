@@ -134,6 +134,10 @@ class TrainConfig:
     head_chunk: int = 128
     # classification: label-smoothing ε (MLPerf ResNet-50 uses 0.1).
     label_smoothing: float = 0.0
+    # Megatron-style sequence parallelism: shard the LN/residual regions'
+    # seq dim over tp between blocks (parallel/tp.tp_rules(sequence_parallel
+    # =True) threaded through build_all). Needs mesh.tp > 1 to have effect.
+    sequence_parallel: bool = False
     log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
     # Debug/fault tooling (SURVEY §5): the XLA-world equivalents of the

@@ -312,12 +312,41 @@ def validate_params(model, params, example_input=None):
     ).get("params", {})
     want_tree = jax.tree.map(jnp.shape, want)
     got_tree = jax.tree.map(jnp.shape, params)
-    if want_tree != got_tree:
-        missing = set(want_tree) - set(got_tree)
-        extra = set(got_tree) - set(want_tree)
-        raise ValueError(
-            "ported params do not match the model's param tree "
-            f"(missing top-level: {sorted(missing)}, extra: {sorted(extra)}"
-            " — check model kwargs, e.g. tie_embeddings vs the checkpoint's"
-            " tie_word_embeddings)"
+    if want_tree == got_tree:
+        return
+    # Diff the FLATTENED trees and name the offending leaves: a deep shape
+    # or structure mismatch (e.g. a wrong head_dim reshape inside
+    # block_3/attn) must point at the leaf, not report empty top-level sets
+    # (ADVICE r3 #3).
+    flat = lambda t: {  # noqa: E731
+        jax.tree_util.keystr(path): shape
+        for path, shape in jax.tree_util.tree_flatten_with_path(
+            t, is_leaf=lambda x: isinstance(x, tuple)  # shapes are leaves
+        )[0]
+    }
+    want_flat, got_flat = flat(want_tree), flat(got_tree)
+    missing = sorted(set(want_flat) - set(got_flat))
+    extra = sorted(set(got_flat) - set(want_flat))
+    mismatched = sorted(
+        k for k in set(want_flat) & set(got_flat)
+        if want_flat[k] != got_flat[k]
+    )
+    detail = []
+    if missing:
+        detail.append(f"missing leaves: {missing[:5]}")
+    if extra:
+        detail.append(f"extra leaves: {extra[:5]}")
+    if mismatched:
+        detail.append(
+            "shape mismatches: "
+            + "; ".join(
+                f"{k}: want {want_flat[k]}, got {got_flat[k]}"
+                for k in mismatched[:5]
+            )
         )
+    raise ValueError(
+        "ported params do not match the model's param tree ("
+        + "; ".join(detail)
+        + " — check model kwargs, e.g. tie_embeddings vs the checkpoint's"
+        " tie_word_embeddings)"
+    )

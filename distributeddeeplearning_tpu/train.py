@@ -466,6 +466,17 @@ class Trainer:
             check_vma=False,
         )(grads, opt_state, params)
 
+    def _check_accum_divides(self, batch) -> None:
+        """Equal-sized microbatch groups are what makes mean-of-group-means
+        equal the whole-batch mean — an uneven split would silently bias the
+        loss/grads, so refuse it loudly (not as a reshape trace error)."""
+        n = jax.tree.leaves(batch)[0].shape[0]
+        if n % self.grad_accum:
+            raise ValueError(
+                f"grad_accum={self.grad_accum} must divide the global "
+                f"batch size {n}"
+            )
+
     def _make_pipeline_train_step(self):
         """schedule='1f1b_interleaved': the pipeline engine computes loss AND
         grads inside one schedule (parallel/pp.interleaved_1f1b), so the step
@@ -489,6 +500,7 @@ class Trainer:
 
         def step_fn(state: TrainState, batch):
             if self.grad_accum > 1:
+                self._check_accum_divides(batch)
                 groups = jax.tree.map(
                     lambda x: x.reshape(
                         (self.grad_accum, x.shape[0] // self.grad_accum)
@@ -567,6 +579,7 @@ class Trainer:
                     )
                     return (grads_acc, metrics_acc, updates), None
 
+                self._check_accum_divides(batch)
                 mb0 = jax.tree.map(
                     lambda x: x.reshape((self.grad_accum, -1) + x.shape[1:]), batch
                 )

@@ -232,6 +232,28 @@ def test_validate_params_catches_tie_mismatch():
         validate_params(tied, p_untied)
 
 
+def test_validate_params_names_deep_mismatched_leaf():
+    # ADVICE r3 #3: a deep shape mismatch (wrong head_dim reshape inside a
+    # block) must name the offending leaf path and both shapes — not raise
+    # with empty top-level missing/extra sets.
+    from flax.core import meta
+
+    from distributeddeeplearning_tpu.hf_port import validate_params
+
+    model = models.get_model("llama", size="tiny", vocab_size=64, max_len=32)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), tokens))["params"]
+    # Wrong head_dim reshape deep in the tree: [embed, heads, head_dim] ->
+    # [embed, heads/2, head_dim*2] (same element count, wrong split).
+    block = next(k for k in params if k.startswith("block_"))
+    leaf = params[block]["attn"]["query"]["kernel"]
+    params[block]["attn"]["query"]["kernel"] = leaf.reshape(
+        leaf.shape[0], leaf.shape[1] // 2, leaf.shape[2] * 2
+    )
+    with pytest.raises(ValueError, match=r"query.*want.*got"):
+        validate_params(model, params)
+
+
 @pytest.mark.parametrize("impl", ["ulysses", "ulysses_flash"])
 def test_ulysses_on_cp_mesh_matches_single_device(mesh1, impl):
     # Sequence<->heads all-to-all reshard with GQA-repeated heads: the
