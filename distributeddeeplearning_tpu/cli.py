@@ -141,22 +141,29 @@ def cmd_eval(cfg: Config) -> int:
     return 0
 
 
-def cmd_generate(cfg: Config, prompt: str, max_new_tokens: int,
+def cmd_generate(cfg: Config, prompts: list[str], max_new_tokens: int,
                  temperature: float, seed: int, *, top_k: int = 0,
-                 top_p: float = 0.0) -> int:
+                 top_p: float = 0.0, bench: bool = False) -> int:
     """Sample text from the latest checkpoint (or fresh init) with the
     KV-cache decoder (``generate.py``). Assumes a BYTE tokenizer
-    (``prepare_data --tokenizer byte``): the prompt is encoded as UTF-8
-    bytes, the completion decoded back."""
+    (``prepare_data --tokenizer byte``): prompts are encoded as UTF-8
+    bytes, completions decoded back. Repeating ``--prompt`` batches UNEVEN
+    prompts (left-padded, HF semantics); ``--bench`` re-runs the compiled
+    loop once more and reports the steady-state decode tokens/sec."""
+    import time
+
     import numpy as np
 
     from .generate import generate as run_generate
+    from .generate import pad_prompts
 
     # Cheap argument validation BEFORE the expensive model build/restore.
     if temperature == 0.0 and (top_k or top_p):
         raise ValueError(
             "--top-k/--top-p only apply when sampling — set --temperature"
         )
+    if any(not p for p in prompts):
+        raise ValueError("prompt must be non-empty")
     mesh, model, trainer, dataset = build_all(cfg)
     if not hasattr(model, "decode"):
         raise ValueError(
@@ -176,11 +183,11 @@ def cmd_generate(cfg: Config, prompt: str, max_new_tokens: int,
         )
     state = _restore_or_init(cfg, trainer, dataset.batch(0), "generating from")
 
-    tokens = np.frombuffer(
-        prompt.encode("utf-8"), np.uint8
-    ).astype(np.int32)[None, :]
-    if tokens.size == 0:
-        raise ValueError("prompt must be non-empty")
+    encoded = [
+        np.frombuffer(p.encode("utf-8"), np.uint8).astype(np.int32)
+        for p in prompts
+    ]
+    tokens, lens = pad_prompts(encoded, pad_id=0)
     if tokens.shape[1] + max_new_tokens > getattr(model, "max_len", 1 << 30):
         raise ValueError(
             f"prompt ({tokens.shape[1]}) + max_new_tokens "
@@ -194,18 +201,42 @@ def cmd_generate(cfg: Config, prompt: str, max_new_tokens: int,
         updates["mesh"] = None
     if updates:
         model = model.clone(**updates)
-    out = run_generate(
-        model, state.params, tokens, max_new_tokens=max_new_tokens,
-        temperature=temperature, top_k=top_k, top_p=top_p,
-        rng=jax.random.PRNGKey(seed),
+    kw = dict(
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p, rng=jax.random.PRNGKey(seed),
+        prompt_lens=lens,
     )
-    new = np.asarray(out[0, tokens.shape[1]:])
-    completion = bytes(int(t) for t in new).decode(
-        "utf-8", errors="replace"
+    out = jax.block_until_ready(
+        run_generate(model, state.params, tokens, **kw)
     )
-    print(json.dumps({
-        "step": int(state.step), "prompt": prompt, "completion": completion,
-    }))
+    record: dict = {"step": int(state.step)}
+    if bench:
+        # The first call compiled; this one measures the compiled loop. The
+        # loop runs P + max_new - 1 one-token cache steps per row (prompt
+        # consumption IS single-token decode steps here, same per-step
+        # cost), so the honest steady-state rate counts every step — new-
+        # tokens-only over the whole window would understate it by the
+        # prefill fraction.
+        t0 = time.perf_counter()
+        jax.block_until_ready(run_generate(model, state.params, tokens, **kw))
+        dt = time.perf_counter() - t0
+        n_steps = tokens.shape[1] + max_new_tokens - 1
+        record["decode_tokens_per_sec"] = round(
+            len(prompts) * n_steps / dt, 2
+        )
+        record["decode_steps_timed"] = n_steps
+    P = tokens.shape[1]
+    results = []
+    for i, p in enumerate(prompts):
+        new = np.asarray(out[i, P:])
+        results.append({
+            "prompt": p,
+            "completion": bytes(int(t) for t in new).decode(
+                "utf-8", errors="replace"
+            ),
+        })
+    record["results"] = results
+    print(json.dumps(record))
     return 0
 
 
@@ -288,12 +319,21 @@ def main(argv=None) -> int:
             "before backend init",
         )
         if name == "generate":
-            p.add_argument("--prompt", required=True)
+            p.add_argument(
+                "--prompt", required=True, action="append",
+                help="repeatable: a batch of (uneven) prompts decodes "
+                "together via left padding",
+            )
             p.add_argument("--max-new-tokens", type=int, default=64)
             p.add_argument("--temperature", type=float, default=0.0)
             p.add_argument("--top-k", type=int, default=0)
             p.add_argument("--top-p", type=float, default=0.0)
             p.add_argument("--seed", type=int, default=0)
+            p.add_argument(
+                "--bench", action="store_true",
+                help="re-run the compiled decode loop once and report "
+                "steady-state tokens/sec",
+            )
     args = parser.parse_args(argv)
     if args.xla_perf_flags:
         # Env-level, so it must precede EVERY backend touch — including the
@@ -314,7 +354,7 @@ def main(argv=None) -> int:
     if args.cmd == "generate":
         return cmd_generate(
             cfg, args.prompt, args.max_new_tokens, args.temperature,
-            args.seed, top_k=args.top_k, top_p=args.top_p,
+            args.seed, top_k=args.top_k, top_p=args.top_p, bench=args.bench,
         )
     if args.cmd == "benchmark":
         try:

@@ -77,13 +77,23 @@ def _filter_logits(logits, top_k, top_p):
     static_argnums=(0,),
     static_argnames=("max_new_tokens", "sample", "filtered"),
 )
-def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p, *,
-                  max_new_tokens, sample, filtered):
+def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p,
+                  starts, *, max_new_tokens, sample, filtered):
     B, P = prompt.shape
     total = P + max_new_tokens
     cache = model.init(
         jax.random.PRNGKey(0), jnp.zeros((B, total), jnp.int32)
     )["cache"]
+    # Left-padded batches: every cache subtree carries a per-row 'start'
+    # ([B], number of left pads) that hides pad columns from attention and
+    # offsets positions so each row's first real token sits at position 0
+    # (transformer.decode_attention / llama rope). Pad-free = all zeros.
+    cache = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (
+            starts if getattr(path[-1], "key", None) == "start" else leaf
+        ),
+        cache,
+    )
     buf = jnp.concatenate(
         [prompt.astype(jnp.int32), jnp.zeros((B, max_new_tokens), jnp.int32)],
         axis=1,
@@ -122,6 +132,22 @@ def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p, *,
     return buf
 
 
+def pad_prompts(prompts, pad_id: int = 0):
+    """Left-pad a list of uneven token sequences into ([B, P] int32 array,
+    [B] lengths) for :func:`generate(prompt_lens=...)` — HF left-padding
+    layout: every row's real content is right-aligned."""
+    import numpy as np
+
+    lens = np.array([len(p) for p in prompts], np.int32)
+    if (lens == 0).any():
+        raise ValueError("empty prompt in batch")
+    P = int(lens.max())
+    out = np.full((len(prompts), P), pad_id, np.int32)
+    for i, p in enumerate(prompts):
+        out[i, P - len(p):] = np.asarray(p, np.int32)
+    return out, lens
+
+
 def generate(
     model,
     params,
@@ -132,12 +158,18 @@ def generate(
     top_k: int = 0,
     top_p: float = 0.0,
     rng=None,
+    prompt_lens=None,
 ):
     """Generate ``max_new_tokens`` after ``prompt`` [B, P] int32.
 
     ``temperature=0`` is greedy argmax; ``>0`` samples (``rng`` required),
     optionally restricted to the ``top_k`` highest logits and/or the
     ``top_p`` nucleus. Returns the full [B, P + max_new_tokens] buffer.
+
+    ``prompt_lens`` ([B] ints) batches UNEVEN prompts: ``prompt`` must then
+    be LEFT-padded (row b's real tokens are its last ``prompt_lens[b]`` —
+    see :func:`pad_prompts`); attention never sees the pad columns and
+    positions are per-row, matching HF's left-padding generation semantics.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature>0) requires rng")
@@ -147,10 +179,21 @@ def generate(
         model = model.clone(decode=True)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    prompt = jnp.asarray(prompt)
+    B, P = prompt.shape
+    if prompt_lens is None:
+        starts = jnp.zeros((B,), jnp.int32)
+    else:
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+        if prompt_lens.shape != (B,):
+            raise ValueError(
+                f"prompt_lens must be [batch]={B}, got {prompt_lens.shape}"
+            )
+        starts = P - prompt_lens
     return _generate_jit(
-        model, params, jnp.asarray(prompt), rng,
+        model, params, prompt, rng,
         jnp.float32(temperature if temperature > 0 else 1.0),
-        jnp.int32(top_k), jnp.float32(top_p),
+        jnp.int32(top_k), jnp.float32(top_p), starts,
         max_new_tokens=int(max_new_tokens), sample=temperature > 0.0,
         filtered=bool(top_k or top_p),
     )

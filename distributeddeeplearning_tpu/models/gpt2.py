@@ -67,14 +67,24 @@ class GPT2(nn.Module):
         )
         if self.decode:
             # Position cursor for the cache-decoding path (the attention
-            # cursors live per-layer; this one feeds wpe).
+            # cursors live per-layer; this one feeds wpe). 'start' ([B],
+            # left-pad counts, default 0) keeps a left-padded row's first
+            # real token at position 0 — HF's attention-mask-cumsum
+            # position_ids numbering (see generate.py).
             pos = self.variable(
                 "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = self.variable(
+                "cache", "start", lambda: jnp.zeros((B,), jnp.int32)
             )
             if self.is_initializing():
                 positions = jnp.arange(L)[None, :]
             else:
-                positions = pos.value + jnp.arange(L)[None, :]
+                positions = jnp.maximum(
+                    pos.value + jnp.arange(L)[None, :]
+                    - start.value[:, None],
+                    0,
+                )
                 pos.value = pos.value + L
         else:
             positions = jnp.arange(L)[None, :]

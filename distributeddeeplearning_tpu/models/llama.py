@@ -56,22 +56,28 @@ class RMSNorm(nn.Module):
 
 
 def rope_tables(positions, head_dim: int, theta: float):
-    """fp32 (cos, sin) tables, [L, head_dim//2] — HF Llama's layout
-    (``inv_freq = theta ** -(arange(0, d, 2) / d)``)."""
+    """fp32 (cos, sin) tables, [..., L, head_dim//2] — HF Llama's layout
+    (``inv_freq = theta ** -(arange(0, d, 2) / d)``). ``positions`` may be
+    [L] (shared) or [B, L] (per-row, e.g. left-padded decode)."""
     half = head_dim // 2
     inv_freq = theta ** -(np.arange(0, half, dtype=np.float32) * 2 / head_dim)
-    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
 def apply_rope(x, cos, sin):
     """Rotate-half RoPE on [B, L, H, D] (HF formulation: the two halves of
-    the head dim rotate against each other)."""
+    the head dim rotate against each other). Tables are [L, half] (shared
+    positions) or [B, L, half] (per-row positions)."""
     half = x.shape[-1] // 2
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    expand = (
+        (lambda t: t[None, :, None, :]) if cos.ndim == 2
+        else (lambda t: t[:, :, None, :])
+    )
+    c = expand(cos)
+    s = expand(sin)
     return jnp.concatenate(
         [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
     ).astype(x.dtype)
@@ -119,46 +125,58 @@ class LlamaAttention(nn.Module):
 
         positions = jnp.arange(L)
         idx_var = None
+        start_var = None
         if self.decode:
-            # RoPE at the cache cursor; the variable is registered ONCE
-            # here and passed into decode_attention (which advances it).
+            # RoPE at the cache cursor; the variables are registered ONCE
+            # here and passed into decode_attention (which advances idx).
             idx_var = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
+            start_var = self.variable(
+                "cache", "start", lambda: jnp.zeros((B,), jnp.int32)
+            )
             if not self.is_initializing():
-                positions = idx_var.value + positions
+                # Per-row positions: a left-padded row's first REAL token
+                # rotates at position 0 (HF computes position_ids from the
+                # attention-mask cumsum — same contiguous numbering).
+                positions = jnp.maximum(
+                    idx_var.value + positions[None, :]
+                    - start_var.value[:, None],
+                    0,
+                )
         cos, sin = rope_tables(positions, self.head_dim, self.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
         # GQA: repeat KV groups up to the query head count, then run any
         # MHA core. HF orders repeats group-major (head g*r+i reads kv g).
-        # (Decode caches the repeated kv — simple over minimal.)
+        # Decode caches the PRE-repeat kv (num_kv_heads slabs — GQA's cache
+        # memory benefit, ADVICE r3 #4) and repeats per step at use.
         rep = self.num_heads // self.num_kv_heads
-        if rep > 1:
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-
         if self.decode:
             out = decode_attention(
                 self, q, k, v, dtype=self.dtype, attn_impl=self.attn_impl,
-                idx_var=idx_var,
-            )
-        elif self.attn_impl in ("ulysses", "ulysses_flash"):
-            # Sequence<->heads all-to-all reshard around an MHA core
-            # (GQA already repeated above, so head counts match q).
-            from ..parallel.sp_ulysses import ulysses_attention
-
-            out = ulysses_attention(
-                q, k, v, flash=self.attn_impl == "ulysses_flash",
-                causal=True, dtype=self.dtype, mesh=self.mesh,
-                num_heads=self.num_heads,
+                idx_var=idx_var, num_rep=rep, start_var=start_var,
             )
         else:
-            out = attention_core(
-                q, k, v, impl=self.attn_impl, causal=True, dtype=self.dtype,
-                mesh=self.mesh,
-            )
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            if self.attn_impl in ("ulysses", "ulysses_flash"):
+                # Sequence<->heads all-to-all reshard around an MHA core
+                # (GQA already repeated above, so head counts match q).
+                from ..parallel.sp_ulysses import ulysses_attention
+
+                out = ulysses_attention(
+                    q, k, v, flash=self.attn_impl == "ulysses_flash",
+                    causal=True, dtype=self.dtype, mesh=self.mesh,
+                    num_heads=self.num_heads,
+                )
+            else:
+                out = attention_core(
+                    q, k, v, impl=self.attn_impl, causal=True,
+                    dtype=self.dtype, mesh=self.mesh,
+                )
 
         out = nn.DenseGeneral(
             features=E,

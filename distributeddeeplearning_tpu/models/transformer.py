@@ -98,7 +98,7 @@ def attention_core(
 
 
 def decode_attention(module, q, k, v, *, dtype, attn_impl="xla",
-                     idx_var=None):
+                     idx_var=None, num_rep: int = 1, start_var=None):
     """One autoregressive decode step against a KV cache (used by
     ``SelfAttention`` and ``models/llama.LlamaAttention`` when
     ``decode=True``; driven by ``generate.py``).
@@ -108,6 +108,16 @@ def decode_attention(module, q, k, v, *, dtype, attn_impl="xla",
     (= the total generation budget) and a ``cache_index`` cursor. Real
     calls feed one token: its k/v are written at the cursor, q attends over
     the visible prefix, the cursor advances.
+
+    ``num_rep`` (GQA): k/v arrive PRE-repeat ([B, L, kv_heads, D]) and are
+    cached that way — the cache is ``num_heads/num_kv_heads`` times smaller
+    than the query head count implies (ADVICE r3 #4: caching the repeated
+    kv erodes GQA's memory benefit); the repeat happens per step at use.
+
+    Left-padded batches: a per-row ``start`` cache variable ([B], default
+    0 = pad-free) hides columns before each row's first real token, so
+    ``generate(prompt_lens=...)`` can batch uneven prompts (HF left-padding
+    semantics).
     """
     if attn_impl != "xla":
         raise NotImplementedError(
@@ -121,21 +131,49 @@ def decode_attention(module, q, k, v, *, dtype, attn_impl="xla",
     idx = idx_var if idx_var is not None else module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
     )
+    start = start_var if start_var is not None else module.variable(
+        "cache", "start", lambda: jnp.zeros((k.shape[0],), jnp.int32)
+    )
+
+    def rep(t):
+        return jnp.repeat(t, num_rep, axis=2) if num_rep > 1 else t
+
     if module.is_initializing():
         # Shape-only pass: create the cache at this call's length and run
         # plain causal attention so init produces valid outputs.
-        return attention_core(q, k, v, impl="xla", causal=True, dtype=dtype)
+        return attention_core(
+            q, rep(k), rep(v), impl="xla", causal=True, dtype=dtype
+        )
     B, L, H, D = q.shape
     if L != 1:
         raise ValueError(f"decode feeds one token at a time, got L={L}")
     ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx.value, 0, 0))
     cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx.value, 0, 0))
     max_len = ck.value.shape[1]
-    visible = (jnp.arange(max_len) <= idx.value)[None, None, None, :]
-    out = attention_core(
-        q, ck.value, cv.value, impl="xla", causal=False, dtype=dtype,
-        mask=visible,
-    )
+    cols = jnp.arange(max_len)
+    visible = (
+        (cols <= idx.value)[None, :] & (cols[None, :] >= start.value[:, None])
+    )[:, None, None, :]
+    if num_rep > 1:
+        # Grouped-head core: contract each query-head group directly
+        # against the UN-repeated cache — materializing rep(ck.value) every
+        # step would transiently re-spend the exact HBM the pre-repeat
+        # cache saves. Same math as the xla core on repeated heads (repeat
+        # is group-major: query head g*rep+r reads kv group g).
+        kv_heads = ck.value.shape[2]
+        qg = q.reshape(B, L, kv_heads, num_rep, D)
+        scores = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, ck.value
+        ).astype(jnp.float32) / np.sqrt(D)
+        scores = jnp.where(visible[:, :, :, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv.value)
+        out = out.reshape(B, L, H, D)
+    else:
+        out = attention_core(
+            q, ck.value, cv.value, impl="xla", causal=False,
+            dtype=dtype, mask=visible,
+        )
     idx.value = idx.value + 1
     return out
 

@@ -320,6 +320,7 @@ class Trainer:
         params = variables.pop("params")
         # sow()-collections are per-step outputs, not persistent state.
         variables.pop("losses", None)
+        variables.pop("metrics", None)
         opt_state = self.tx.init(params)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
@@ -399,7 +400,11 @@ class Trainer:
         # "losses" collects model-internal objective terms sown during the
         # forward pass (e.g. the MoE router's load-balancing loss); it is
         # folded into the objective here and never persisted into the state.
-        mutable = list(model_state.keys()) + ["losses"] if train else []
+        # "metrics" collects model-internal observability scalars (e.g. the
+        # router's dropped-token fraction); surfaced as train metrics.
+        mutable = (
+            list(model_state.keys()) + ["losses", "metrics"] if train else []
+        )
         inputs = self.task.input_fn(batch)
         with nn.logical_axis_rules(self.rules):
             if mutable:
@@ -414,11 +419,27 @@ class Trainer:
                 )
                 updates = dict(model_state)
         aux = updates.pop("losses", None)
+        sown_metrics = updates.pop("metrics", None)
         loss, metrics = self.task.loss_fn(out, batch)
         if aux:
             aux_total = sum(jnp.sum(v) for v in jax.tree.leaves(aux))
             loss = loss + aux_total
             metrics = {**metrics, "aux_loss": aux_total}
+        if sown_metrics:
+            # Aggregate by sown name across module instances (each MoE layer
+            # sows its own value): the logged metric is their mean.
+            groups: dict[str, list] = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                sown_metrics
+            )[0]:
+                name = [p.key for p in path if hasattr(p, "key")][-1]
+                groups.setdefault(name, []).append(
+                    jnp.asarray(leaf, jnp.float32)
+                )
+            metrics = {
+                **metrics,
+                **{k: jnp.mean(jnp.stack(v)) for k, v in groups.items()},
+            }
         return loss, (metrics, updates)
 
     def _tx_update(self, grads, opt_state, params):

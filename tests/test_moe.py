@@ -89,6 +89,35 @@ def _train_losses(mesh, steps=3, **model_kwargs):
     return losses, state
 
 
+def test_dropped_token_fraction_is_a_train_metric(mesh1, mesh_factory):
+    # VERDICT r3 #5: the router's capacity drops must be VISIBLE. A
+    # starved capacity factor must report a large dropped fraction; an
+    # ample one reports ~0; and the metric agrees between the single-device
+    # and ep-sharded runs (same deterministic routing).
+    def one_step(mesh, capacity_factor):
+        model = models.get_model(
+            "gpt2_moe", size="tiny", vocab_size=64, max_len=32,
+            num_experts=4, moe_every=2, capacity_factor=capacity_factor,
+        )
+        trainer = Trainer(
+            model, make_optimizer("adamw", 1e-2), get_task("lm"), mesh,
+            donate=False,
+        )
+        ds = SyntheticTokens(batch_size=8, seq_len=16, vocab_size=64)
+        state = trainer.init(0, ds.batch(0))
+        batch = next(iter(sharded_batches(ds.iter_from(0), mesh)))
+        _, metrics = trainer.train_step(state, batch)
+        assert "moe_dropped_frac" in metrics, sorted(metrics)
+        return float(metrics["moe_dropped_frac"])
+
+    starved = one_step(mesh1, 0.25)
+    ample = one_step(mesh1, 4.0)
+    assert 0.2 <= starved <= 1.0, starved
+    assert ample <= 1e-6, ample
+    sharded = one_step(mesh_factory(dp=2, ep=4), 0.25)
+    np.testing.assert_allclose(sharded, starved, atol=1e-6)
+
+
 class TestExpertParallelParity:
     def test_ep4_dp2_matches_single_device(self, mesh1, mesh_factory):
         ref, _ = _train_losses(mesh1)

@@ -111,6 +111,36 @@ print("LLAMA_TPU_OK", loss)
     assert "LLAMA_TPU_OK" in out
 
 
+def test_ep_token_exchange_lowers_to_all_to_all_on_tpu():
+    # The all-to-all-SPECIFIC form of the EP dispatch assert (VERDICT r3
+    # #5): XLA's CPU SPMD pipeline lowers the token exchange in gather form
+    # (see tests/test_hlo_collectives.py::test_ep_emits_token_exchange for
+    # the measured counts), so the a2a assertion is pinned to the TPU
+    # backend. Needs ep>1 => multi-chip; skips (with a recorded marker) on
+    # the single-chip environment.
+    out = run_on_tpu("""
+import jax
+assert jax.default_backend() == "tpu", jax.default_backend()
+if jax.device_count() < 2:
+    print("EP_TPU_SKIP_single_chip")
+    raise SystemExit(0)
+import sys
+sys.path.insert(0, "tests")
+from test_hlo_collectives import compiled_step_text
+from distributeddeeplearning_tpu.mesh import MeshConfig, build_mesh
+from distributeddeeplearning_tpu.utils.hlo import collective_counts
+mesh = build_mesh(MeshConfig(dp=1, ep=jax.device_count()))
+counts = collective_counts(compiled_step_text(
+    mesh, model_name="gpt2_moe",
+    num_experts=jax.device_count(), moe_every=2))
+assert counts["all-to-all"] > 0, counts
+print("EP_TPU_A2A_OK", dict(counts))
+""")
+    if "EP_TPU_SKIP_single_chip" in out:
+        pytest.skip("EP a2a lowering needs >1 TPU chip (ep>1)")
+    assert "EP_TPU_A2A_OK" in out
+
+
 def test_generation_on_tpu():
     # KV-cache decode loop compiles and runs on the chip: greedy tokens
     # from a fresh tiny Llama, exact match against the full-forward oracle.
