@@ -220,11 +220,12 @@ def cmd_generate(cfg: Config, prompts: list[str], max_new_tokens: int,
         t0 = time.perf_counter()
         jax.block_until_ready(run_generate(model, state.params, tokens, **kw))
         dt = time.perf_counter() - t0
-        n_steps = tokens.shape[1] + max_new_tokens - 1
-        record["decode_tokens_per_sec"] = round(
-            len(prompts) * n_steps / dt, 2
-        )
-        record["decode_steps_timed"] = n_steps
+        # Real tokens only: each row consumes its own prompt + produces
+        # max_new; a short row's left-pad steps are not tokens (counting
+        # them would inflate the rate by the padding fraction).
+        n_tokens = int(lens.sum()) + len(prompts) * max_new_tokens
+        record["decode_tokens_per_sec"] = round(n_tokens / dt, 2)
+        record["decode_steps_timed"] = tokens.shape[1] + max_new_tokens - 1
     P = tokens.shape[1]
     results = []
     for i, p in enumerate(prompts):
