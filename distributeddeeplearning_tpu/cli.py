@@ -211,21 +211,17 @@ def cmd_generate(cfg: Config, prompts: list[str], max_new_tokens: int,
     )
     record: dict = {"step": int(state.step)}
     if bench:
-        # The first call compiled; this one measures the compiled loop. The
-        # loop runs P + max_new - 1 one-token cache steps per row (prompt
-        # consumption IS single-token decode steps here, same per-step
-        # cost), so the honest steady-state rate counts every step — new-
-        # tokens-only over the whole window would understate it by the
-        # prefill fraction.
+        # The first call compiled; this one measures the compiled loop:
+        # ONE bulk-prefill forward over the whole prompt + max_new - 1
+        # one-token cache steps (generate.py). The rate counts real tokens
+        # only — each row's own prompt length + its new tokens; a short
+        # row's left-pad positions are not tokens.
         t0 = time.perf_counter()
         jax.block_until_ready(run_generate(model, state.params, tokens, **kw))
         dt = time.perf_counter() - t0
-        # Real tokens only: each row consumes its own prompt + produces
-        # max_new; a short row's left-pad steps are not tokens (counting
-        # them would inflate the rate by the padding fraction).
         n_tokens = int(lens.sum()) + len(prompts) * max_new_tokens
         record["decode_tokens_per_sec"] = round(n_tokens / dt, 2)
-        record["decode_steps_timed"] = tokens.shape[1] + max_new_tokens - 1
+        record["decode_steps_timed"] = max_new_tokens  # prefill + N-1 steps
     P = tokens.shape[1]
     results = []
     for i, p in enumerate(prompts):

@@ -75,10 +75,11 @@ def _filter_logits(logits, top_k, top_p):
 @functools.partial(
     jax.jit,
     static_argnums=(0,),
-    static_argnames=("max_new_tokens", "sample", "filtered"),
+    static_argnames=("max_new_tokens", "sample", "filtered", "bulk_prefill"),
 )
 def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p,
-                  starts, *, max_new_tokens, sample, filtered):
+                  starts, *, max_new_tokens, sample, filtered,
+                  bulk_prefill=True):
     B, P = prompt.shape
     total = P + max_new_tokens
     cache = model.init(
@@ -99,13 +100,7 @@ def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p,
         axis=1,
     )
 
-    def step(carry, i):
-        buf, cache, rng = carry
-        tok = lax.dynamic_slice(buf, (0, i), (B, 1))
-        out, vars_ = model.apply(
-            {"params": params, "cache": cache}, tok, mutable=["cache"]
-        )
-        logits = _logits_of(out)[:, -1, :]
+    def pick(logits, rng):
         if sample:
             # temperature/top_k/top_p are TRACED operands: sweeping them
             # re-runs, never recompiles. Temperature FIRST, then filtering
@@ -115,9 +110,43 @@ def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p,
             if filtered:
                 logits = _filter_logits(logits, top_k, top_p)
             rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, logits, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
+            return jax.random.categorical(sub, logits, axis=-1), rng
+        return jnp.argmax(logits, axis=-1), rng
+
+    if bulk_prefill:
+        # The whole prompt in ONE forward (decode_attention's L>1 path):
+        # the MXU sees [B, P]-shaped matmuls instead of P sequential
+        # one-token steps — O(P) fewer kernel launches and the standard
+        # TPU prefill/decode split.
+        from .ops.chunked_xent import is_chunked_head
+
+        out, vars_ = model.apply(
+            {"params": params, "cache": cache}, prompt.astype(jnp.int32),
+            mutable=["cache"],
+        )
+        if is_chunked_head(out):
+            # Only the last position feeds sampling — slice the hidden
+            # BEFORE the head einsum would materialize [B, P, V] logits.
+            out = dict(out, hidden=out["hidden"][:, -1:])
+        first, rng = pick(_logits_of(out)[:, -1, :], rng)
+        buf = lax.dynamic_update_slice(
+            buf, first.astype(jnp.int32)[:, None], (0, P)
+        )
+        cache = vars_["cache"]
+        loop_start = P
+    else:
+        # One-token prefill (capacity-MoE models: a bulk prefill routes
+        # the whole prompt through expert capacity at once and may drop
+        # tokens a one-token stream would keep, changing decode numerics).
+        loop_start = 0
+
+    def step(carry, i):
+        buf, cache, rng = carry
+        tok = lax.dynamic_slice(buf, (0, i), (B, 1))
+        out, vars_ = model.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"]
+        )
+        nxt, rng = pick(_logits_of(out)[:, -1, :], rng)
         # Positions < P-1 keep the prompt token already in the buffer;
         # the model still consumed tok so its KV cache covers the prefix.
         keep_prompt = (i + 1) < P
@@ -127,7 +156,7 @@ def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p,
         return (buf, vars_["cache"], rng), None
 
     (buf, _, _), _ = lax.scan(
-        step, (buf, cache, rng), jnp.arange(total - 1)
+        step, (buf, cache, rng), jnp.arange(loop_start, total - 1)
     )
     return buf
 
@@ -175,6 +204,8 @@ def generate(
         raise ValueError("sampling (temperature>0) requires rng")
     if temperature == 0.0 and (top_k or top_p):
         raise ValueError("top_k/top_p only apply when sampling")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
     if getattr(model, "decode", False) is not True:
         model = model.clone(decode=True)
     if rng is None:
@@ -196,4 +227,8 @@ def generate(
         jnp.int32(top_k), jnp.float32(top_p), starts,
         max_new_tokens=int(max_new_tokens), sample=temperature > 0.0,
         filtered=bool(top_k or top_p),
+        # Capacity-MoE models keep the one-token prefill: bulk routing of
+        # the whole prompt can drop tokens at capacity, changing decode
+        # numerics vs the one-token stream (module docstring).
+        bulk_prefill=not hasattr(model, "num_experts"),
     )

@@ -145,15 +145,21 @@ def decode_attention(module, q, k, v, *, dtype, attn_impl="xla",
             q, rep(k), rep(v), impl="xla", causal=True, dtype=dtype
         )
     B, L, H, D = q.shape
-    if L != 1:
-        raise ValueError(f"decode feeds one token at a time, got L={L}")
+    # L == 1: one decode step. L > 1: BULK PREFILL — the whole prompt is
+    # cached and attended in one forward (L sequential steps of tiny
+    # matmuls would waste the MXU; generate.py's prefill path feeds the
+    # prompt here in one call). Query t sits at absolute position idx + t.
     ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx.value, 0, 0))
     cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx.value, 0, 0))
     max_len = ck.value.shape[1]
     cols = jnp.arange(max_len)
+    qpos = idx.value + jnp.arange(L)
+    # [B, L, max_len]: causal within the written prefix, pad columns (< a
+    # row's start) never visible.
     visible = (
-        (cols <= idx.value)[None, :] & (cols[None, :] >= start.value[:, None])
-    )[:, None, None, :]
+        (cols[None, None, :] <= qpos[None, :, None])
+        & (cols[None, None, :] >= start.value[:, None, None])
+    )
     if num_rep > 1:
         # Grouped-head core: contract each query-head group directly
         # against the UN-repeated cache — materializing rep(ck.value) every
@@ -165,16 +171,16 @@ def decode_attention(module, q, k, v, *, dtype, attn_impl="xla",
         scores = jnp.einsum(
             "bqgrd,bkgd->bgrqk", qg, ck.value
         ).astype(jnp.float32) / np.sqrt(D)
-        scores = jnp.where(visible[:, :, :, None, :], scores, -1e30)
+        scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
         out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv.value)
         out = out.reshape(B, L, H, D)
     else:
         out = attention_core(
             q, ck.value, cv.value, impl="xla", causal=False,
-            dtype=dtype, mask=visible,
+            dtype=dtype, mask=visible[:, None, :, :],
         )
-    idx.value = idx.value + 1
+    idx.value = idx.value + L
     return out
 
 

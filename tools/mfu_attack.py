@@ -69,6 +69,10 @@ def _code_fp() -> str:
                 "distributeddeeplearning_tpu/mesh.py"):
         with open(os.path.join(_REPO, rel), "rb") as f:
             h.update(f.read())
+    # Shrink mode changes what a record MEASURES: a CPU dry-run record must
+    # never satisfy --check for the real matrix (same defense measure_tpu's
+    # fingerprints have — shrink overrides feed the identity).
+    h.update(b"shrunk" if _SHRINK else b"full")
     return h.hexdigest()[:16]
 
 
@@ -119,21 +123,46 @@ def run_cell(name: str, batch: int, flags: bool) -> dict:
         warmup=warmup,
         steps=steps,
     )
+    # start_new_session + killpg (same as measure_tpu's smoke runner): a
+    # timeout — ours here, or chip_watch's outer backstop SIGTERM landing
+    # on THIS process — must never orphan a benchmark child holding the
+    # shared chip. With the child in its own session, the backstop's TERM
+    # to us lets the child be reaped on our exit via the atexit below.
+    import atexit
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", src], cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True,
+    )
+
+    def _reap(signum=None, frame=None):
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        if signum is not None:
+            raise SystemExit(143)
+
+    old_term = signal.signal(signal.SIGTERM, _reap)
+    atexit.register(_reap)
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", src], cwd=_REPO,
-            capture_output=True, text=True, timeout=1500,
-        )
+        out, _ = proc.communicate(timeout=1500)
     except subprocess.TimeoutExpired:
+        _reap()
         return {"error": "cell timed out (chip likely re-wedged)"}
-    for line in proc.stdout.splitlines():
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        atexit.unregister(_reap)
+    for line in (out or "").splitlines():
         if line.startswith("CELL_RESULT "):
             rec = json.loads(line[len("CELL_RESULT "):])
             rec["cell"] = {"batch": batch, "perf_flags": flags}
             if _SHRINK:
                 rec["shrunk"] = True
             return rec
-    return {"error": (proc.stderr or proc.stdout)[-500:]}
+    return {"error": (out or "")[-500:]}
 
 
 def main() -> int:
