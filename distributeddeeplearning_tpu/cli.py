@@ -221,7 +221,12 @@ def cmd_generate(cfg: Config, prompts: list[str], max_new_tokens: int,
         dt = time.perf_counter() - t0
         n_tokens = int(lens.sum()) + len(prompts) * max_new_tokens
         record["decode_tokens_per_sec"] = round(n_tokens / dt, 2)
-        record["decode_steps_timed"] = max_new_tokens  # prefill + N-1 steps
+        # Same gate generate() applies: capacity-MoE models run one-token
+        # prefill, so every prompt position is its own timed step there.
+        record["decode_steps_timed"] = (
+            max_new_tokens if not hasattr(model, "num_experts")
+            else tokens.shape[1] + max_new_tokens - 1
+        )
     P = tokens.shape[1]
     results = []
     for i, p in enumerate(prompts):

@@ -58,6 +58,11 @@ RUNS = [
     # (BENCH_BASELINE.json) and neither uses a Pallas kernel.
     ("resnet18_cifar10", [], 5, 30),
     ("resnet50_imagenet", [], 5, 20),
+    # Decode throughput (VERDICT r3 #9's "tokens/sec bench row"): the
+    # KV-cache generation loop (bulk prefill + one-token steps) on GPT-2
+    # 124M. Not a training config — handled by run_decode_bench; warmup/
+    # steps fields are unused.
+    ("decode:gpt2", [], 0, 0),
 ]
 
 # Tiny-shape overrides per config for DDL_MEASURE_SHRINK=1 (CPU dry-run):
@@ -139,11 +144,67 @@ def _fingerprint(name: str, overrides: list) -> str:
     overrides + the perf-relevant source (``_CODE_FILES``). A committed
     change to any of these invalidates the old number — BASELINE.md must
     never attribute pre-change measurements to the post-change code."""
-    with open(os.path.join(_REPO, "configs", f"{name}.py"), "rb") as f:
-        h = hashlib.sha256(f.read())
+    if name.startswith("decode:"):
+        # Not config-backed: identity = the generation stack's source.
+        # Shrink mode changes the measured shapes and is not visible in
+        # `overrides`, so fold it in — a CPU dry-run record must never
+        # satisfy --check for the real row.
+        h = hashlib.sha256(name.encode())
+        for rel in ("distributeddeeplearning_tpu/generate.py",
+                    "distributeddeeplearning_tpu/models/transformer.py",
+                    "distributeddeeplearning_tpu/models/gpt2.py"):
+            with open(os.path.join(_REPO, rel), "rb") as f:
+                h.update(f.read())
+        h.update(b"shrunk" if _SHRINKING else b"full")
+    else:
+        with open(os.path.join(_REPO, "configs", f"{name}.py"), "rb") as f:
+            h = hashlib.sha256(f.read())
     h.update(json.dumps(overrides).encode())
     h.update(_code_fingerprint().encode())
     return h.hexdigest()[:16]
+
+
+def run_decode_bench() -> dict:
+    """Tokens/sec of the compiled generation loop: bulk prefill over the
+    prompt + one-token KV-cache steps, greedy, GPT-2 124M (tiny under
+    DDL_MEASURE_SHRINK). First call compiles; the second is timed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.generate import generate
+
+    if _SHRINKING:
+        model = models.get_model("gpt2", size="tiny", vocab_size=256,
+                                 max_len=64)
+        batch, prompt_len, max_new = 2, 16, 8
+    else:
+        model = models.get_model("gpt2")  # 124M
+        batch, prompt_len, max_new = 8, 128, 128
+    prompt = np.random.default_rng(0).integers(
+        0, model.vocab_size, (batch, prompt_len), np.int32
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((batch, 2), jnp.int32)
+    )["params"]
+    jax.block_until_ready(
+        generate(model, params, prompt, max_new_tokens=max_new)
+    )
+    t0 = time.time()
+    out = generate(model, params, prompt, max_new_tokens=max_new)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    return {
+        "metric": "gpt2_decode_throughput",
+        "value": round(batch * (prompt_len + max_new) / dt, 2),
+        "unit": "tokens/sec/chip",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "platform": jax.default_backend(),
+        "device_count": jax.device_count(),
+    }
 
 
 def _load_records() -> dict:
@@ -327,11 +388,16 @@ def main() -> int:
                   "the next window", flush=True)
             break
         try:
-            cfg = apply_overrides(
-                load_config(os.path.join(_REPO, "configs", f"{name}.py")),
-                overrides,
-            )
-            record = run_benchmark(cfg, warmup=warmup, steps=steps)
+            if name.startswith("decode:"):
+                record = run_decode_bench()
+            else:
+                cfg = apply_overrides(
+                    load_config(
+                        os.path.join(_REPO, "configs", f"{name}.py")
+                    ),
+                    overrides,
+                )
+                record = run_benchmark(cfg, warmup=warmup, steps=steps)
             record["config_fingerprint"] = _fingerprint(name, overrides)
             if _SHRINKING:
                 record["shrunk"] = True  # dry-run artifact, not a real number

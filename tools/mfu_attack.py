@@ -151,6 +151,7 @@ def run_cell(name: str, batch: int, flags: bool) -> dict:
         out, _ = proc.communicate(timeout=1500)
     except subprocess.TimeoutExpired:
         _reap()
+        proc.communicate()  # reap the SIGKILLed child (no zombie per cell)
         return {"error": "cell timed out (chip likely re-wedged)"}
     finally:
         signal.signal(signal.SIGTERM, old_term)
