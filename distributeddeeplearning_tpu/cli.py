@@ -219,12 +219,12 @@ def cmd_generate(cfg: Config, prompts: list[str], max_new_tokens: int,
         t0 = time.perf_counter()
         jax.block_until_ready(run_generate(model, state.params, tokens, **kw))
         dt = time.perf_counter() - t0
+        from .generate import uses_bulk_prefill
+
         n_tokens = int(lens.sum()) + len(prompts) * max_new_tokens
         record["decode_tokens_per_sec"] = round(n_tokens / dt, 2)
-        # Same gate generate() applies: capacity-MoE models run one-token
-        # prefill, so every prompt position is its own timed step there.
         record["decode_steps_timed"] = (
-            max_new_tokens if not hasattr(model, "num_experts")
+            max_new_tokens if uses_bulk_prefill(model)
             else tokens.shape[1] + max_new_tokens - 1
         )
     P = tokens.shape[1]

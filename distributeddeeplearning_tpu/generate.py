@@ -161,6 +161,14 @@ def _generate_jit(model, params, prompt, rng, temperature, top_k, top_p,
     return buf
 
 
+def uses_bulk_prefill(model) -> bool:
+    """THE gate deciding bulk vs one-token prefill (shared with callers
+    that report per-step stats, e.g. ``cli generate --bench``): capacity-
+    MoE models keep the one-token stream — bulk routing of a whole prompt
+    can drop tokens at expert capacity, changing decode numerics."""
+    return not hasattr(model, "num_experts")
+
+
 def pad_prompts(prompts, pad_id: int = 0):
     """Left-pad a list of uneven token sequences into ([B, P] int32 array,
     [B] lengths) for :func:`generate(prompt_lens=...)` — HF left-padding
@@ -227,8 +235,5 @@ def generate(
         jnp.int32(top_k), jnp.float32(top_p), starts,
         max_new_tokens=int(max_new_tokens), sample=temperature > 0.0,
         filtered=bool(top_k or top_p),
-        # Capacity-MoE models keep the one-token prefill: bulk routing of
-        # the whole prompt can drop tokens at capacity, changing decode
-        # numerics vs the one-token stream (module docstring).
-        bulk_prefill=not hasattr(model, "num_experts"),
+        bulk_prefill=uses_bulk_prefill(model),
     )

@@ -49,6 +49,16 @@ def test_artifact_provenance_complete(record):
     assert record["train_records"] >= 4096
 
 
+def test_resume_leg_reproduces_final_eval(record):
+    # A fresh build_all + orbax restore of the final checkpoint must land
+    # on the same step and reproduce the held-out accuracy (deterministic
+    # eval batches) — the recipe's resume wire, validated at real state.
+    assert record["resumed_step"] == record["steps"]
+    assert abs(
+        record["resumed_eval_accuracy"] - record["final_eval_accuracy"]
+    ) < 0.005
+
+
 def test_history_shows_learning(record):
     # Eval accuracy must RISE over the run (first eval vs final), and train
     # loss must fall — the artifact carries the full curve for the judge.

@@ -117,5 +117,28 @@ def test_augmentation_identity_at_pad0_noflip():
     assert (out == imgs).all()
 
 
+@given(
+    lens=st.lists(st.integers(1, 24), min_size=1, max_size=6),
+    pad_id=st.integers(0, 255),
+    seed=st.integers(0, 2**16),
+)
+@settings(deadline=None, suppress_health_check=[HealthCheck.too_slow])
+def test_pad_prompts_left_aligns_and_round_trips(lens, pad_id, seed):
+    # generate()'s left-padding contract: row b's real tokens are its LAST
+    # len_b columns (verbatim), everything before is pad_id, and the
+    # returned lengths recover each original prompt exactly.
+    from distributeddeeplearning_tpu.generate import pad_prompts
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 256, (n,), np.int32) for n in lens]
+    padded, out_lens = pad_prompts(prompts, pad_id=pad_id)
+    P = max(lens)
+    assert padded.shape == (len(lens), P)
+    assert list(out_lens) == lens
+    for i, p in enumerate(prompts):
+        assert (padded[i, P - len(p):] == p).all()
+        assert (padded[i, : P - len(p)] == pad_id).all()
+
+
 def teardown_module(module):
     os.unlink(_TOKF.name)

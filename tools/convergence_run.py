@@ -42,7 +42,11 @@ SIZE = 32
 TRAIN_N = 8192
 EVAL_N = 2048
 ACCURACY_BAR = 0.60
-ARTIFACT = os.path.join(_REPO, "CONVERGENCE.json")
+# DDL_CONV_OUT: alternate artifact path (smoke/dry runs must not clobber
+# the committed artifact).
+ARTIFACT = os.environ.get(
+    "DDL_CONV_OUT", os.path.join(_REPO, "CONVERGENCE.json")
+)
 
 
 def class_templates(seed: int = 1234) -> np.ndarray:
@@ -120,6 +124,10 @@ def run(steps: int, out_dir: str) -> dict:
     eval_sha = write_split(eval_path, EVAL_N, seed=2)  # disjoint draw
     gen_s = round(time.time() - t0, 1)
 
+    from distributeddeeplearning_tpu.checkpoint import CheckpointManager
+    from distributeddeeplearning_tpu.train import evaluate
+
+    ckpt_dir = os.path.join(out_dir, "ckpt")
     overrides = [
         # The shipped resnet18_cifar10 recipe, pointed at the record files:
         # C++ loader + in-loader augmentation + label smoothing + cosine.
@@ -133,6 +141,8 @@ def run(steps: int, out_dir: str) -> dict:
         f"train.eval_every={max(steps // 12, 1)}",
         f"train.eval_batches={EVAL_N // 128}",
         "train.log_every=20",
+        f"train.checkpoint_dir={ckpt_dir}",
+        f"train.save_every={max(steps // 3, 1)}",
         # Full-width ResNet-18 is ~10 s/step on the CPU sim; width 32 keeps
         # the bounded budget while exercising identical recipe machinery.
         'model.kwargs={"num_classes":10,"width":32,"stem":"cifar"}',
@@ -146,20 +156,48 @@ def run(steps: int, out_dir: str) -> dict:
     mesh, _, trainer, dataset = build_all(cfg)
     state = trainer.init(cfg.train.seed, dataset.batch(0))
     batches = prefetch(sharded_batches(dataset.iter_from(0), mesh))
+    ckpt = CheckpointManager(ckpt_dir)
     t1 = time.time()
-    state, history = fit(
-        trainer, state, batches,
-        steps=cfg.train.steps,
-        log_every=cfg.train.log_every,
-        log_fn=lambda m: print(json.dumps(m), flush=True),
-        eval_every=cfg.train.eval_every,
-        eval_fn=make_eval_fn(cfg, mesh),
-    )
+    try:
+        state, history = fit(
+            trainer, state, batches,
+            steps=cfg.train.steps,
+            log_every=cfg.train.log_every,
+            log_fn=lambda m: print(json.dumps(m), flush=True),
+            eval_every=cfg.train.eval_every,
+            eval_fn=make_eval_fn(cfg, mesh),
+            ckpt=ckpt,
+            save_every=cfg.train.save_every,
+        )
+        ckpt.wait()
+        # fit() saves on the save_every cadence only — force a final-step
+        # checkpoint when the cadence doesn't divide steps, so the resume
+        # leg always restores the exact final state.
+        if ckpt.latest_step() != int(state.step):
+            ckpt.save(int(state.step), state,
+                      {"next_index": int(state.step)})
+            ckpt.wait()
+    finally:
+        ckpt.close()
     train_s = round(time.time() - t1, 1)
 
     evals = [h for h in history if "eval_accuracy" in h]
     final_acc = evals[-1]["eval_accuracy"] if evals else 0.0
     best_acc = max((h["eval_accuracy"] for h in evals), default=0.0)
+
+    # Resume leg (the recipe's LAST unvalidated wire): a FRESH build_all +
+    # restore of the final checkpoint must reproduce the same held-out
+    # accuracy — exercising the orbax restore path at real (not toy) state
+    # through the same helper the CLI's restore flows use.
+    from distributeddeeplearning_tpu.cli import _restore_or_init
+
+    mesh2, _, trainer2, dataset2 = build_all(cfg)
+    state2 = _restore_or_init(cfg, trainer2, dataset2.batch(0), "resuming")
+    resumed_metrics = evaluate(trainer2, state2, make_eval_fn(cfg, mesh2)())
+    resumed_acc = resumed_metrics["eval_accuracy"]
+    resumed_step = int(state2.step)
+    print(json.dumps({"resumed_step": resumed_step,
+                      "resumed_eval_accuracy": resumed_acc}), flush=True)
     return {
         "task": "synthcifar-10 (procedural; no real CIFAR-10 in this "
                 "environment — see module docstring)",
@@ -175,6 +213,8 @@ def run(steps: int, out_dir: str) -> dict:
         "accuracy_bar": ACCURACY_BAR,
         "final_eval_accuracy": round(final_acc, 4),
         "best_eval_accuracy": round(best_acc, 4),
+        "resumed_step": resumed_step,
+        "resumed_eval_accuracy": round(resumed_acc, 4),
         "bar_met": bool(final_acc >= ACCURACY_BAR),
         "chance_accuracy": 1.0 / N_CLASSES,
         "platform": "cpu-sim dp8",
