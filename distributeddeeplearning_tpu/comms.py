@@ -19,6 +19,8 @@ Mapping (reference -> here):
 
 from __future__ import annotations
 
+import functools
+
 import jax
 from jax import lax
 
@@ -90,3 +92,75 @@ def broadcast(x, axis: str, *, src: int = 0):
     idx = lax.axis_index(axis)
     masked = jax.tree.map(lambda a: jax.numpy.where(idx == src, a, 0), x)
     return lax.psum(masked, axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_identity_bwd(x, axis: str):
+    """``psum`` whose TRANSPOSE is the identity — the correct adjoint for a
+    row-parallel layer output (``out = psum_tp(partial)``: the true
+    ``d(partial)`` on every rank is the full output cotangent, once).
+
+    Why it exists: under ``shard_map(check_vma=False)`` a raw ``lax.psum``
+    inside a ``jax.vjp``'d region transposes to ANOTHER psum, multiplying
+    every cotangent that crosses it by the axis size (measured in
+    ``tests/test_comms.py``). The vma checker would fix the transpose but
+    deadlocks the CPU collectives runtime on the interleaved-1F1B engine's
+    cond/scan structure, so manual-AD engines (``parallel/pp.py``) require
+    in-body row-parallel reductions to use THIS op. Under vma-on shard_map
+    or outer-``jax.grad`` paths it is numerically identical to the raw
+    psum's correct behavior, so the blocks use it unconditionally.
+    """
+    return lax.psum(x, axis)
+
+
+def _psum_identity_fwd(x, axis: str):
+    return lax.psum(x, axis), None
+
+
+def _psum_identity_bwd(axis: str, _, g):
+    # The primal input is VARYING over ``axis`` while the psum output (and
+    # hence ``g``) is invariant — under vma-ON shard_map the bwd rule must
+    # re-vary the cotangent to type-match the input (a no-op on values;
+    # also a no-op under check_vma=False bodies like the interleaved
+    # engine, where pcast is accepted and vma isn't tracked).
+    return (lax.pcast(g, (axis,), to="varying"),)
+
+
+psum_identity_bwd.defvjp(_psum_identity_fwd, _psum_identity_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def identity_fwd_psum_bwd(x, axis: str):
+    """Identity whose TRANSPOSE is ``psum`` — Megatron's "copy to tensor-
+    parallel region" marker (the conjugate of :func:`psum_identity_bwd`).
+
+    Placed where a replicated activation FANS OUT into per-rank slices
+    (before the column-parallel projections): in the backward pass every
+    rank's parallel region contributes only its slice's share of the input
+    cotangent, and this op's transpose sums them into the true full
+    cotangent — on every rank, identically. Together the f/g pair makes a
+    manually-differentiated region (``jax.vjp`` inside
+    ``shard_map(check_vma=False)``, e.g. the interleaved-1F1B engine)
+    produce correct per-rank gradients with no boundary fix-ups: sliced
+    params get their owned-slice grads, replicated params get identical
+    full grads.
+
+    MANUAL-AD ONLY: under vma-ON shard_map with outer autodiff, jax's own
+    invariant-input boundary already supplies the sum — inserting f there
+    double-counts. The models gate it on ``manual_tp_ad`` accordingly;
+    new call sites must do the same.
+    """
+    return x
+
+
+def _identity_fwd(x, axis: str):
+    return x, None
+
+
+def _identity_bwd(axis: str, _, g):
+    return (lax.psum(g, axis),)
+
+
+identity_fwd_psum_bwd.defvjp(_identity_fwd, _identity_bwd)
+
+

@@ -33,6 +33,7 @@ import numpy as np
 from flax import linen as nn
 
 from . import register
+from ..comms import identity_fwd_psum_bwd, psum_identity_bwd
 from ..sharding import constrain
 from .transformer import attention_core, decode_attention, dense_init
 
@@ -97,6 +98,7 @@ class LlamaAttention(nn.Module):
     # out-projection over this axis (projections are bias-free, so no
     # bias pre-scaling is needed — cf. transformer.SelfAttention).
     psum_axis: str | None = None
+    manual_tp_ad: bool = False  # see transformer.SelfAttention.manual_tp_ad
     decode: bool = False  # KV-cache decoding (transformer.decode_attention)
 
     @nn.compact
@@ -107,6 +109,10 @@ class LlamaAttention(nn.Module):
                 f"num_heads {self.num_heads} not a multiple of "
                 f"num_kv_heads {self.num_kv_heads}"
             )
+        if self.psum_axis is not None and self.manual_tp_ad:
+            # Megatron f: entry of the tensor-parallel region (conjugate of
+            # the psum_identity_bwd at its exit).
+            x = identity_fwd_psum_bwd(x, self.psum_axis)
 
         def proj(name, heads):
             return nn.DenseGeneral(
@@ -189,7 +195,7 @@ class LlamaAttention(nn.Module):
             name="out",
         )(out)
         if self.psum_axis is not None:
-            out = jax.lax.psum(out, self.psum_axis)
+            out = psum_identity_bwd(out, self.psum_axis)
         return out
 
 
@@ -200,9 +206,14 @@ class LlamaMlp(nn.Module):
     hidden_dim: int
     dtype: jnp.dtype = jnp.float32
     psum_axis: str | None = None  # manual TP (see LlamaAttention)
+    manual_tp_ad: bool = False  # see transformer.SelfAttention.manual_tp_ad
 
     @nn.compact
     def __call__(self, x):
+        if self.psum_axis is not None and self.manual_tp_ad:
+            # Megatron f (see LlamaAttention): entry of the parallel region.
+            x = identity_fwd_psum_bwd(x, self.psum_axis)
+
         def col(name):
             return nn.Dense(
                 self.hidden_dim, use_bias=False, dtype=self.dtype,
@@ -221,7 +232,7 @@ class LlamaMlp(nn.Module):
             name="down",
         )(h)
         if self.psum_axis is not None:
-            out = jax.lax.psum(out, self.psum_axis)
+            out = psum_identity_bwd(out, self.psum_axis)
         return out
 
 
@@ -251,6 +262,7 @@ class LlamaBlock(nn.Module):
     attn_impl: str = "xla"
     mesh: object = None
     psum_axis: str | None = None  # manual TP inside shard_map (PP×TP)
+    manual_tp_ad: bool = False  # see transformer.SelfAttention.manual_tp_ad
     # False inside pipeline stages: the body runs under shard_map on
     # per-device arrays, where global sharding constraints don't apply.
     constrain_out: bool = True
@@ -262,12 +274,14 @@ class LlamaBlock(nn.Module):
             self.num_heads, self.num_kv_heads, self.head_dim,
             rope_theta=self.rope_theta, dtype=self.dtype,
             attn_impl=self.attn_impl, mesh=self.mesh,
-            psum_axis=self.psum_axis, decode=self.decode, name="attn",
+            psum_axis=self.psum_axis, manual_tp_ad=self.manual_tp_ad,
+            decode=self.decode, name="attn",
         )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
         if self.constrain_out:
             x = constrain(x, "batch", "seq", "embed")
         x = x + LlamaMlp(
-            self.mlp_dim, self.dtype, psum_axis=self.psum_axis, name="mlp"
+            self.mlp_dim, self.dtype, psum_axis=self.psum_axis,
+            manual_tp_ad=self.manual_tp_ad, name="mlp"
         )(RMSNorm(self.rms_eps, self.dtype, name="mlp_norm")(x))
         return constrain(x, "batch", "seq", "embed") if self.constrain_out else x
 

@@ -58,6 +58,9 @@ class PipelineStage(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     psum_axis: str | None = None
+    # Megatron f/g for manually-differentiated engines (interleaved 1F1B):
+    # see transformer.SelfAttention.manual_tp_ad.
+    manual_tp_ad: bool = False
     block_kind: str = "gpt2"  # gpt2 | llama
     num_kv_heads: int = 0  # llama only
     rope_theta: float = 10000.0  # llama only
@@ -77,6 +80,7 @@ class PipelineStage(nn.Module):
                     rms_eps=self.ln_eps,
                     dtype=self.dtype,
                     psum_axis=self.psum_axis,
+                    manual_tp_ad=self.manual_tp_ad,
                     constrain_out=False,
                     name=f"block_{i}",
                 )(x)
@@ -93,9 +97,80 @@ class PipelineStage(nn.Module):
                     dtype=self.dtype,
                     constrain_out=False,
                     psum_axis=self.psum_axis,
+                    manual_tp_ad=self.manual_tp_ad,
                     name=f"block_{i}",
                 )(x, None, deterministic)
         return x
+
+
+def scale_row_parallel_biases(tree, tp: int, inverse: bool = False):
+    """Pre-scale the row-parallel biases (attn ``out`` / mlp ``fc_out``) by
+    ``1/tp``: each tp rank adds the bias to its partial sum, the in-stage
+    psum then restores exactly one bias. No-op on bias-free (Llama) trees.
+
+    ``inverse=True`` multiplies by ``tp`` instead — the GRADIENT correction
+    manual-AD engines need: differentiating through the ``1/tp`` pre-scale
+    yields ``g/tp`` per rank, and unlike the outer-autodiff schedules there
+    is no shard_map boundary sum over tp to restore ``g`` for these
+    replicated leaves (the true gradient of the stored bias is ``g``)."""
+
+    def fix(path, leaf):
+        keys = [getattr(p, "key", None) for p in path]
+        if keys[-1] == "bias" and keys[-2] in ("out", "fc_out"):
+            return leaf * tp if inverse else leaf / tp
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, tree)
+
+
+def stacked_param_specs(init_stacked):
+    """Per-leaf PartitionSpecs for a stage-stacked param tree (PP×TP):
+    ``stage`` -> pp, ``heads``/``mlp`` -> tp, everything else replicated.
+    ``init_stacked(rng)`` is eval_shape'd — nothing materializes."""
+    table = {"stage": "pp", "heads": "tp", "mlp": "tp"}
+    abs_stacked = jax.eval_shape(init_stacked, jax.random.PRNGKey(0))
+    return jax.tree.map(
+        lambda b: PartitionSpec(*[table.get(n) for n in b.names]),
+        abs_stacked,
+        is_leaf=lambda l: isinstance(l, nn.Partitioned),
+    )
+
+
+def manual_tp_stage_setup(arch, *, n_per_stage, num_stages, embed_dim,
+                          dtype, tp, seq_len):
+    """THE PP×TP stage machinery for the manually-differentiated engine
+    (shared by the GPT-2 and Llama ``pipeline_value_and_grad``): the
+    full-size stage module (init/eval shapes), the tp-local body module
+    (``manual_tp_ad=True`` — Megatron f/g markers active), and the stacked
+    param specs. ``tp <= 1`` degenerates to one full module and no specs.
+
+    ``arch``: the model's ``_arch()``/``_stage_arch()`` kwargs dict; any of
+    ``num_heads``/``mlp_dim``/``num_kv_heads`` it carries must divide
+    ``tp``."""
+    stage_mod = PipelineStage(n_per_stage, parent=None, **arch)
+    if tp <= 1:
+        return stage_mod, stage_mod, None
+    keys = [
+        k for k in ("num_heads", "mlp_dim", "num_kv_heads")
+        if arch.get(k)
+    ]
+    if any(arch[k] % tp for k in keys):
+        raise ValueError(
+            "pp×tp: " + ", ".join(f"{k}={arch[k]}" for k in keys)
+            + f" must be divisible by tp={tp}"
+        )
+    stage_mod_body = PipelineStage(
+        n_per_stage, parent=None, psum_axis="tp", manual_tp_ad=True,
+        **{**arch, **{k: arch[k] // tp for k in keys}},
+    )
+    dummy = jnp.zeros((1, seq_len, embed_dim), dtype)
+
+    def init_stacked(rng):
+        rngs = jax.random.split(rng, num_stages)
+        p = jax.vmap(lambda r: stage_mod.init(r, dummy)["params"])(rngs)
+        return stack_stage_axis(p)
+
+    return stage_mod, stage_mod_body, stacked_param_specs(init_stacked)
 
 
 class PipelinedTransformerStack(nn.Module):
@@ -208,25 +283,12 @@ class PipelinedTransformerStack(nn.Module):
 
         stacked = self.param("stages", init_stacked)
 
-        def scale_row_parallel_biases(tree):
-            """Pre-scale the row-parallel biases (attn out / mlp fc_out) by
-            1/tp: each tp rank adds the bias to its partial sum, the psum
-            then restores exactly one bias."""
-
-            def fix(path, leaf):
-                keys = [getattr(p, "key", None) for p in path]
-                if keys[-1] == "bias" and keys[-2] in ("out", "fc_out"):
-                    return leaf / tp
-                return leaf
-
-            return jax.tree_util.tree_map_with_path(fix, tree)
-
         def stage_fn(stage_params, y):
             # Clear the ambient logical-axis rules: inside shard_map arrays
             # are per-device (manual) and flax's param-unbox constraint (which
             # resolves against the rules) must become a no-op.
             if tp > 1:
-                stage_params = scale_row_parallel_biases(stage_params)
+                stage_params = scale_row_parallel_biases(stage_params, tp)
             with nn.logical_axis_rules(()):
                 return stage_mod_body.apply(
                     {"params": stage_params}, y, deterministic
@@ -240,19 +302,8 @@ class PipelinedTransformerStack(nn.Module):
                 )
             param_specs = None
             if tp > 1:
-                # Per-leaf specs from the stacked Partitioned names:
-                # stage -> pp, heads/mlp -> tp, everything else replicated.
-                table = {"stage": "pp", "heads": "tp", "mlp": "tp"}
-                abs_stacked = jax.eval_shape(
-                    init_stacked, jax.random.PRNGKey(0)
-                )
-                param_specs = jax.tree.map(
-                    lambda b: PartitionSpec(
-                        *[table.get(n) for n in b.names]
-                    ),
-                    abs_stacked,
-                    is_leaf=lambda l: isinstance(l, nn.Partitioned),
-                )
+                # Per-leaf specs from the stacked Partitioned names.
+                param_specs = stacked_param_specs(init_stacked)
             # '1f1b_interleaved' training runs through the grads-inside
             # engine (Trainer dispatches to pipeline_value_and_grad); this
             # __call__ path then only serves init/eval, where the forward
@@ -285,7 +336,7 @@ class PipelinedGPT2(nn.Module):
     num_stages: int = 2
     num_microbatches: int = 2
     pipeline: bool = True
-    schedule: str = "gpipe"  # gpipe | 1f1b
+    schedule: str = "gpipe"  # gpipe | 1f1b | 1f1b_interleaved
     dtype: jnp.dtype = jnp.float32
     mesh: object = None
 
@@ -354,25 +405,28 @@ class PipelinedGPT2(nn.Module):
         """(loss, grads) via :func:`parallel.pp.interleaved_1f1b` — the
         engine owns the schedule AND differentiation, so the Trainer calls
         this instead of ``jax.value_and_grad`` (see ``Trainer``). Causal-LM
-        batches only (``batch['tokens']``); dropout and PP×TP are not
-        supported on this path (use schedule='1f1b' for PP×TP)."""
+        batches only (``batch['tokens']``); dropout is not supported here.
+
+        PP×TP: stage params are additionally tp-sliced (same in-stage
+        psum machinery as the gpipe/1f1b schedules — tp-local module +
+        row-parallel bias pre-scaling + ``stacked_param_specs``); the
+        shared embed/head params stay replicated inside the body (their
+        storage remains ``vocab_pp``-sharded)."""
         import optax
 
         from ..parallel.pp import interleaved_1f1b
 
-        if mesh.shape["tp"] > 1:
-            raise NotImplementedError(
-                "schedule='1f1b_interleaved' does not compose with tp>1 "
-                "yet; use schedule='1f1b'"
-            )
-        # parent=None: inside a module method flax would auto-adopt these as
-        # children of self (whose scope is unbound here) — they are
-        # standalone appliers over param subtrees, not submodules. Block
-        # architecture comes from the SAME _arch() dict __call__ uses.
-        stage_mod = PipelineStage(
-            self.num_layers // self.num_stages,
-            parent=None,
-            **self._arch(),
+        # parent=None modules (manual_tp_stage_setup): inside a module
+        # method flax would auto-adopt submodules of self (whose scope is
+        # unbound here) — these are standalone appliers over param
+        # subtrees. Block architecture comes from the SAME _arch() dict
+        # __call__ uses.
+        tp = mesh.shape["tp"] if mesh.shape["pp"] > 1 else 1
+        stage_mod, stage_mod_body, param_specs = manual_tp_stage_setup(
+            self._arch(),
+            n_per_stage=self.num_layers // self.num_stages,
+            num_stages=self.num_stages, embed_dim=self.embed_dim,
+            dtype=self.dtype, tp=tp, seq_len=batch["tokens"].shape[1] - 1,
         )
         wte_mod = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype, parent=None
@@ -393,8 +447,10 @@ class PipelinedGPT2(nn.Module):
             return (x + pos).astype(self.dtype)
 
         def stage_fn(stage_params, y):
+            if tp > 1:
+                stage_params = scale_row_parallel_biases(stage_params, tp)
             with nn.logical_axis_rules(()):
-                return stage_mod.apply({"params": stage_params}, y, True)
+                return stage_mod_body.apply({"params": stage_params}, y, True)
 
         def head_fn(shared, y, bm):
             x = ln_mod.apply({"params": shared["ln_f"]}, y)
@@ -412,7 +468,13 @@ class PipelinedGPT2(nn.Module):
             embed_fn, stage_fn, head_fn, stacked, shared,
             {"tokens": batch["tokens"]},
             mesh=mesh, num_microbatches=self.num_microbatches,
+            param_specs=param_specs,
         )
+        if tp > 1:
+            # Undo the 1/tp bias pre-scale in the GRADS (see
+            # scale_row_parallel_biases(inverse=True)); no-op for the
+            # bias-free Llama stages.
+            dstacked = scale_row_parallel_biases(dstacked, tp, inverse=True)
         grads = {
             "wte": dshared["wte"],
             "wpe": dshared["wpe"],
@@ -425,7 +487,7 @@ class PipelinedGPT2(nn.Module):
 class PipelinedLlama(nn.Module):
     """Llama with a pipelined block stack — same stage machinery as
     :class:`PipelinedGPT2` (GPipe / 1F1B / interleaved 1F1B over ``pp``;
-    PP×TP inside stages for the first two), Llama blocks and head
+    PP×TP inside stages under all three schedules), Llama blocks and head
     (``models/llama.py``)."""
 
     vocab_size: int = 32000
@@ -440,7 +502,7 @@ class PipelinedLlama(nn.Module):
     num_stages: int = 2
     num_microbatches: int = 2
     pipeline: bool = True
-    schedule: str = "gpipe"  # gpipe | 1f1b
+    schedule: str = "gpipe"  # gpipe | 1f1b | 1f1b_interleaved
     dtype: jnp.dtype = jnp.float32
     mesh: object = None
     # LM head shares the embedding table (see models/llama.Llama).
@@ -503,22 +565,20 @@ class PipelinedLlama(nn.Module):
     def pipeline_value_and_grad(self, params, batch, mesh):
         """(loss, grads) via :func:`parallel.pp.interleaved_1f1b` — the
         Llama counterpart of :meth:`PipelinedGPT2.pipeline_value_and_grad`
-        (same engine, Llama embed/stage/head closures). Causal-LM batches
-        only; PP×TP not supported on this path (use schedule='1f1b')."""
+        (same engine, Llama embed/stage/head closures; same PP×TP
+        machinery — tp-local stage module incl. ``num_kv_heads // tp`` +
+        ``stacked_param_specs``). Causal-LM batches only."""
         import optax
 
         from ..parallel.pp import interleaved_1f1b
         from .llama import RMSNorm
 
-        if mesh.shape["tp"] > 1:
-            raise NotImplementedError(
-                "schedule='1f1b_interleaved' does not compose with tp>1 "
-                "yet; use schedule='1f1b'"
-            )
-        stage_mod = PipelineStage(
-            self.num_layers // self.num_stages,
-            parent=None,
-            **self._stage_arch(),
+        tp = mesh.shape["tp"] if mesh.shape["pp"] > 1 else 1
+        stage_mod, stage_mod_body, param_specs = manual_tp_stage_setup(
+            self._stage_arch(),
+            n_per_stage=self.num_layers // self.num_stages,
+            num_stages=self.num_stages, embed_dim=self.embed_dim,
+            dtype=self.dtype, tp=tp, seq_len=batch["tokens"].shape[1] - 1,
         )
         embed_mod = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype, parent=None
@@ -533,7 +593,7 @@ class PipelinedLlama(nn.Module):
 
         def stage_fn(stage_params, y):
             with nn.logical_axis_rules(()):
-                return stage_mod.apply({"params": stage_params}, y, True)
+                return stage_mod_body.apply({"params": stage_params}, y, True)
 
         def head_fn(shared, y, bm):
             x = norm_mod.apply({"params": shared["norm"]}, y)
@@ -562,7 +622,13 @@ class PipelinedLlama(nn.Module):
             embed_fn, stage_fn, head_fn, stacked, shared,
             {"tokens": batch["tokens"]},
             mesh=mesh, num_microbatches=self.num_microbatches,
+            param_specs=param_specs,
         )
+        if tp > 1:
+            # Undo the 1/tp bias pre-scale in the GRADS (see
+            # scale_row_parallel_biases(inverse=True)); no-op for the
+            # bias-free Llama stages.
+            dstacked = scale_row_parallel_biases(dstacked, tp, inverse=True)
         grads = {**dshared, "h": {"stages": dstacked}}
         return loss, grads
 

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from ..comms import identity_fwd_psum_bwd, psum_identity_bwd
 from ..sharding import constrain
 
 Dtype = jnp.dtype
@@ -221,6 +222,12 @@ class SelfAttention(nn.Module):
     # out-projection over this axis. The out bias must be pre-scaled 1/tp by
     # the caller (it is added per-rank before the psum).
     psum_axis: str | None = None
+    # Megatron f/g markers for MANUALLY-differentiated engines (jax.vjp
+    # inside shard_map(check_vma=False), e.g. interleaved 1F1B): the entry
+    # marker must NOT run under outer-autodiff paths, whose shard_map
+    # transpose already inserts the reduction (enabling both would double
+    # the input-cotangent).
+    manual_tp_ad: bool = False
     # Autoregressive decoding with a KV cache (generate.py): the module
     # keeps cached_key/cached_value/cache_index in the 'cache' collection.
     # The init call (any length) only shapes the cache; real calls then
@@ -230,6 +237,11 @@ class SelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
         features = x.shape[-1]
+        if self.psum_axis is not None and self.manual_tp_ad:
+            # Megatron f: entry of the tensor-parallel region (conjugate of
+            # the psum_identity_bwd at its exit) — the input cotangent is
+            # the SUM of the per-rank head-slice contributions.
+            x = identity_fwd_psum_bwd(x, self.psum_axis)
         proj = lambda name: nn.DenseGeneral(  # noqa: E731
             features=(self.num_heads, self.head_dim),
             dtype=self.dtype,
@@ -334,7 +346,7 @@ class SelfAttention(nn.Module):
             name="out",
         )(out)
         if self.psum_axis is not None:
-            out = jax.lax.psum(out, self.psum_axis)
+            out = psum_identity_bwd(out, self.psum_axis)
         return out
 
 
@@ -348,11 +360,15 @@ class Mlp(nn.Module):
     # fc_out is the row-parallel matmul reduced here; fc_out bias must be
     # pre-scaled 1/tp by the caller.
     psum_axis: str | None = None
+    manual_tp_ad: bool = False  # see SelfAttention.manual_tp_ad
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         features = x.shape[-1]
         act = {"gelu_exact": gelu_exact, "gelu_tanh": gelu_tanh}[self.activation]
+        if self.psum_axis is not None and self.manual_tp_ad:
+            # Megatron f (see SelfAttention): entry of the parallel region.
+            x = identity_fwd_psum_bwd(x, self.psum_axis)
         h = nn.Dense(
             self.hidden_dim,
             dtype=self.dtype,
@@ -375,7 +391,7 @@ class Mlp(nn.Module):
             name="fc_out",
         )(h)
         if self.psum_axis is not None:
-            h = jax.lax.psum(h, self.psum_axis)
+            h = psum_identity_bwd(h, self.psum_axis)
         return nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
 
 
@@ -407,6 +423,7 @@ class TransformerBlock(nn.Module):
     constrain_out: bool = True
     # Manual TP inside shard_map (PP×TP): forwarded to the attn/mlp modules.
     psum_axis: str | None = None
+    manual_tp_ad: bool = False  # see SelfAttention.manual_tp_ad
     decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
 
     @nn.compact
@@ -421,6 +438,7 @@ class TransformerBlock(nn.Module):
             attn_impl=self.attn_impl,
             mesh=self.mesh,
             psum_axis=self.psum_axis,
+            manual_tp_ad=self.manual_tp_ad,
             decode=self.decode,
             name="attn",
         )
@@ -431,6 +449,7 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype,
             init_scale=self.init_scale,
             psum_axis=self.psum_axis,
+            manual_tp_ad=self.manual_tp_ad,
             name="mlp",
         )
         ln1 = layer_norm(self.ln_eps, self.dtype, "ln1")

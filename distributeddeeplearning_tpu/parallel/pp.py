@@ -370,6 +370,7 @@ def interleaved_1f1b(
         take = lambda m: jax.tree.map(lambda t: t[m], mb)  # noqa: E731
         params_sq = jax.tree.map(lambda p: jnp.squeeze(p, 0), stacked)
 
+        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
         # Shapes: probe one microbatch's activation abstractly.
         x0_shape = jax.eval_shape(lambda: embed_fn(shared, take(0)))
         zeros_x = jnp.zeros(x0_shape.shape, x0_shape.dtype)
@@ -438,6 +439,12 @@ def interleaved_1f1b(
             mb_i = jnp.clip(mb_idx, 0, M - 1)
             g_in = jnp.where(stage == S - 1, g_y, c["recv_bwd"])
             x_b = stash[mb_i % depth]
+            # PP×TP needs no boundary fix-ups here: the stage body brackets
+            # its tensor-parallel regions with comms.identity_fwd_psum_bwd /
+            # psum_identity_bwd (Megatron f/g), so this vjp already yields
+            # full input-cotangents and per-rank-correct param grads
+            # (owned slices for tp-sharded leaves, identical full grads for
+            # replicated ones).
             _, svjp = jax.vjp(stage_fn, params_sq, x_b)
             dp, dx = svjp(g_in)
             dstacked = jax.tree.map(
@@ -481,7 +488,6 @@ def interleaved_1f1b(
         # replica-mean — this psum is THE data-parallel gradient sync (the
         # reference's NCCL all-reduce), emitted here explicitly because the
         # engine owns differentiation instead of jax.grad.
-        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
         nrep = 1
         for a in batch_axes:
             nrep *= mesh.shape[a]
@@ -490,6 +496,7 @@ def interleaved_1f1b(
             lambda g: jax.lax.psum(g, (axis_name,) + batch_axes) / nrep,
             c["dshared"],
         )
+
         dstacked = jax.tree.map(
             lambda g: jnp.expand_dims(
                 jax.lax.psum(g, batch_axes) / nrep, 0
@@ -498,6 +505,15 @@ def interleaved_1f1b(
         )
         return loss, dstacked, dshared
 
+    # check_vma=False: turning the checker ON deadlocks the CPU collectives
+    # runtime on this engine's cond/scan structure (measured: devices split
+    # between an all-reduce and a collective-permute rendezvous). The
+    # protection the checker would give is provided instead by (a) the
+    # compiled collective-count assert (tests/test_pipeline.py) and (b) the
+    # PP×TP rule that every psum inside the differentiated stage body must
+    # be comms.psum_identity_bwd — under check_vma=False a RAW lax.psum's
+    # transpose is psum, which double-counts every cotangent crossing it
+    # (the identity transpose is the correct one for row-parallel outputs).
     fn = jax.shard_map(
         local,
         mesh=mesh,

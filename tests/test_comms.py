@@ -119,3 +119,72 @@ def test_axis_primitives(mesh8):
     idx, size = f()
     np.testing.assert_array_equal(idx, np.arange(8))
     assert int(size[0]) == 8
+
+
+def test_megatron_fg_transposes_under_manual_ad(mesh8):
+    # The f/g pair's raison d'être (parallel/pp.interleaved_1f1b): inside
+    # shard_map(check_vma=False), a RAW lax.psum's transpose is psum — a
+    # jax.vjp'd region crossing it multiplies the cotangent by the axis
+    # size. g (psum_identity_bwd) pins the identity transpose; f
+    # (identity_fwd_psum_bwd) pins the conjugate (sum of per-rank
+    # contributions). Asserted against in-body vjp cotangents on an 8-way
+    # axis.
+    import jax
+
+    def cotangent_of(fn):
+        def body(w):
+            _, vjp = jax.vjp(fn, w)
+            (dw,) = vjp(jnp.ones(()))
+            return dw[None]
+
+        out = jax.shard_map(
+            body, mesh=mesh8, in_specs=(P(),), out_specs=P("dp"),
+            check_vma=False,
+        )(jnp.ones(()))
+        return np.asarray(out)
+
+    # raw psum: transpose is psum -> cotangent is axis_size on every rank.
+    raw = cotangent_of(lambda w: jax.lax.psum(w * 1.0, "dp"))
+    np.testing.assert_array_equal(raw, np.full(8, 8.0))
+    # g: identity transpose -> the full output cotangent, once, per rank.
+    g = cotangent_of(lambda w: comms.psum_identity_bwd(w * 1.0, "dp"))
+    np.testing.assert_array_equal(g, np.ones(8))
+    # f: identity forward; transpose sums the per-rank contributions.
+    f = cotangent_of(lambda w: comms.identity_fwd_psum_bwd(w * 1.0, "dp"))
+    np.testing.assert_array_equal(f, np.full(8, 8.0))
+
+
+def test_psum_identity_bwd_types_under_vma_on(mesh8):
+    # The bwd rule must RE-VARY its cotangent over the reduced axis: with
+    # stock JAX (jax_disable_bwd_checks=False — this container's axon
+    # sitecustomize flips it globally, which would mask the bug) a bwd rule
+    # returning an invariant cotangent for a varying primal is a trace-time
+    # error under vma-ON shard_map. Pin the stock-config behavior.
+    import jax
+
+    old = jax.config.jax_disable_bwd_checks
+    jax.config.update("jax_disable_bwd_checks", False)
+    try:
+        def body(w):
+            # g's contract spans BOTH vma modes (the blocks use it
+            # unconditionally): under vma-on its bwd must pcast the
+            # cotangent back to varying — without that, stock JAX raises
+            # "Custom VJP bwd rule must produce an output with the same
+            # type". w replicated; per-rank slice compute; g at the exit;
+            # jax's own invariant-input boundary supplies the sum.
+            scale = jax.lax.axis_index("dp").astype(jnp.float32) + 1.0
+
+            def fwd(t):
+                return comms.psum_identity_bwd(t * scale, "dp")
+
+            y, vjp = jax.vjp(fwd, w)
+            (dw,) = vjp(jnp.ones_like(y))
+            return dw
+
+        out = jax.shard_map(
+            body, mesh=mesh8, in_specs=(P(),), out_specs=P(),
+        )(jnp.ones((1,)))
+        # d/dw sum_r (r+1) * w = 36, identically on every rank.
+        np.testing.assert_array_equal(np.asarray(out), np.full(1, 36.0))
+    finally:
+        jax.config.update("jax_disable_bwd_checks", old)

@@ -180,6 +180,39 @@ def test_padded_batch_matches_hf_greedy_generate(family):
     np.testing.assert_array_equal(ours, theirs.numpy())
 
 
+@pytest.mark.parametrize("name", ["gpt2", "llama"])
+def test_bulk_prefill_matches_one_token_prefill(name):
+    # The two prefill modes (one forward over the prompt vs P sequential
+    # one-token steps) must leave IDENTICAL cache state and therefore emit
+    # identical greedy tokens — pinned pad-free AND left-padded, so an
+    # off-by-one in the bulk path's cursor/visibility/start handling can't
+    # hide behind the HF tests' short prompts.
+    from distributeddeeplearning_tpu.generate import _generate_jit, pad_prompts
+
+    jax.config.update("jax_default_matmul_precision", "float32")
+    try:
+        model = models.get_model(name, size="tiny", vocab_size=97, max_len=48)
+        model = model.clone(decode=True)
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(1, 97, (n,), np.int32) for n in (9, 4, 6)]
+        padded, lens = pad_prompts(prompts, pad_id=0)
+        params = model.init(
+            jax.random.PRNGKey(3), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        P = padded.shape[1]
+        starts = jnp.asarray(P - lens, jnp.int32)
+        args = (
+            model, params, jnp.asarray(padded), jax.random.PRNGKey(0),
+            jnp.float32(1.0), jnp.int32(0), jnp.float32(0.0), starts,
+        )
+        kw = dict(max_new_tokens=7, sample=False, filtered=False)
+        bulk = np.asarray(_generate_jit(*args, bulk_prefill=True, **kw))
+        seq = np.asarray(_generate_jit(*args, bulk_prefill=False, **kw))
+        np.testing.assert_array_equal(bulk, seq)
+    finally:
+        jax.config.update("jax_default_matmul_precision", None)
+
+
 def test_sampling_is_rng_deterministic_and_in_vocab():
     model = models.get_model("gpt2", size="tiny", vocab_size=53, max_len=32)
     prompt = np.random.default_rng(0).integers(0, 53, (2, 4), np.int32)

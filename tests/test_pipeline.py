@@ -257,6 +257,62 @@ class TestInterleaved1F1B:
         stubbed = compiled_counts()
         assert real["all-reduce"] > stubbed["all-reduce"], (real, stubbed)
 
+    def test_pp2_tp2_composes(self, mesh1, mesh_factory):
+        # PP×TP under the interleaved engine (previously an explicit
+        # NotImplementedError): tp-local stages + in-stage psums inside the
+        # grads-owning schedule, GPT-2 and Llama.
+        for model_name in ("gpt2_pp", "llama_pp"):
+            ref = _train_losses(
+                mesh1, pipeline=False, num_stages=2, model_name=model_name
+            )
+            pp = _train_losses(
+                mesh_factory(dp=2, pp=2, tp=2), pipeline=True, num_stages=2,
+                schedule="1f1b_interleaved", model_name=model_name,
+            )
+            np.testing.assert_allclose(ref, pp, rtol=2e-5, err_msg=model_name)
+
+    @pytest.mark.parametrize("model_name", ["gpt2_pp", "llama_pp"])
+    def test_pp2_tp2_per_leaf_grad_parity(self, mesh_factory, model_name):
+        # Loss-trajectory parity under AdamW is blind to constant per-leaf
+        # gradient scalings (m/sqrt(v) cancels them) — exactly the failure
+        # class a missing/doubled psum in the f/g bracketing produces. So
+        # compare the engine's RAW gradients per leaf against jax.grad of
+        # the sequential oracle.
+        import optax
+        from flax.core import meta
+
+        mesh = mesh_factory(pp=2, tp=2)
+        kw = dict(size="tiny", vocab_size=64, max_len=32,
+                  num_stages=2, num_microbatches=2)
+        engine_model = models.get_model(
+            model_name, schedule="1f1b_interleaved", mesh=mesh, **kw
+        )
+        seq_model = models.get_model(model_name, pipeline=False, **kw)
+        ds = SyntheticTokens(batch_size=8, seq_len=16, vocab_size=64)
+        batch = {k: jnp.asarray(v) for k, v in ds.batch(0).items()}
+        params = meta.unbox(
+            seq_model.init(jax.random.PRNGKey(0), batch["tokens"][:, :-1])
+        )["params"]
+
+        def oracle_loss(p):
+            logits = seq_model.apply({"params": p}, batch["tokens"][:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch["tokens"][:, 1:]
+            ).mean()
+
+        lo, go = jax.value_and_grad(oracle_loss)(params)
+        lp, gp = jax.jit(
+            lambda p, b: engine_model.pipeline_value_and_grad(p, b, mesh)
+        )(params, batch)
+        np.testing.assert_allclose(float(lp), float(lo), rtol=1e-5)
+        flat_o = jax.tree_util.tree_flatten_with_path(go)[0]
+        flat_p = jax.tree_util.tree_flatten_with_path(gp)[0]
+        for (path, a), (_, b) in zip(flat_o, flat_p):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=2e-6, rtol=2e-5,
+                err_msg=jax.tree_util.keystr(path),
+            )
+
     def test_grad_accum_composes(self, mesh1, mesh_factory):
         # VERDICT r3 #4: the reference's DP+accumulation workload
         # (BASELINE.json:9) must be runnable under the framework's best
