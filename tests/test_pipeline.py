@@ -325,6 +325,19 @@ class TestInterleaved1F1B:
         )
         np.testing.assert_allclose(ref, inter, rtol=2e-5)
 
+    def test_grad_accum_tp_composes(self, mesh1, mesh_factory):
+        # The full matrix corner: dp × pp × tp × accum under the
+        # interleaved engine (outer accum scan over an f/g-bracketed
+        # tp-local pipeline).
+        ref = _train_losses(
+            mesh1, pipeline=False, grad_accum=2, num_stages=2
+        )
+        inter = _train_losses(
+            mesh_factory(dp=2, pp=2, tp=2), pipeline=True, grad_accum=2,
+            num_stages=2, schedule="1f1b_interleaved",
+        )
+        np.testing.assert_allclose(ref, inter, rtol=2e-5)
+
     def test_stash_bounded_by_pipeline_depth(self):
         # The schedule's defining property: for M >> S the interleaved
         # engine holds at most 2S microbatch activations; the custom_vjp
