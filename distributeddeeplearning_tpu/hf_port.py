@@ -289,6 +289,56 @@ def port_from_hf(model_name: str, hf_model):
     return PORTERS[model_name](hf_model)
 
 
+def to_pipelined(params, num_stages: int):
+    """Convert a FLAT GPT-2/Llama param tree — including HF-ported ones
+    (:func:`port_from_hf`) — into the stage-stacked layout of the
+    ``gpt2_pp`` / ``llama_pp`` models, so a pretrained checkpoint can run
+    under pipeline parallelism.
+
+    Mapping: per-layer blocks (GPT-2: ``h/block_i``; Llama: top-level
+    ``block_i``) are grouped into ``num_stages`` contiguous stages and
+    stacked on a leading stage axis under ``h/stages/block_j`` (j = the
+    stage-LOCAL layer index); everything else (embeddings, final norm,
+    lm_head) maps through unchanged. Validate with
+    :func:`validate_params` against the pipelined model afterwards.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if "h" in params:  # GPT-2 family: blocks live under 'h'
+        blocks = dict(params["h"])
+        other = {k: v for k, v in params.items() if k != "h"}
+    else:  # Llama family: blocks at the top level
+        blocks = {
+            k: v for k, v in params.items() if k.startswith("block_")
+        }
+        other = {
+            k: v for k, v in params.items() if not k.startswith("block_")
+        }
+    n_layers = len(blocks)
+    missing = [
+        f"block_{i}" for i in range(n_layers) if f"block_{i}" not in blocks
+    ]
+    if missing or not n_layers:
+        raise ValueError(
+            f"unrecognized flat param tree (layers={n_layers}, "
+            f"missing={missing[:3]})"
+        )
+    if n_layers % num_stages:
+        raise ValueError(
+            f"num_layers={n_layers} not divisible by num_stages={num_stages}"
+        )
+    per = n_layers // num_stages
+    stages = {
+        f"block_{j}": jax.tree.map(
+            lambda *leaves: jnp.stack(leaves),
+            *[blocks[f"block_{s * per + j}"] for s in range(num_stages)],
+        )
+        for j in range(per)
+    }
+    return {**other, "h": {"stages": stages}}
+
+
 def validate_params(model, params, example_input=None):
     """Raise if ``params`` doesn't match ``model``'s own param tree
     (structure and shapes).

@@ -573,6 +573,60 @@ class TestPipelinedLlama:
         np.testing.assert_allclose(ref, inter, rtol=2e-5)
 
 
+class TestToPipelined:
+    """hf_port.to_pipelined: a flat (e.g. HF-ported) checkpoint converts
+    into the stage-stacked layout — pretrained models can be pipelined."""
+
+    def _logits_parity(self, flat_name, pp_name, mesh1, flat_extra=None,
+                       **kw):
+        from flax.core import meta
+
+        from distributeddeeplearning_tpu.hf_port import (
+            to_pipelined,
+            validate_params,
+        )
+
+        flat = models.get_model(flat_name, **kw, **(flat_extra or {}))
+        pp = models.get_model(
+            pp_name, num_stages=2, num_microbatches=2, pipeline=False, **kw
+        )
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 8), np.int32)
+        )
+        params = meta.unbox(flat.init(jax.random.PRNGKey(0), tokens))[
+            "params"
+        ]
+        converted = to_pipelined(params, num_stages=2)
+        validate_params(pp, converted, tokens)
+        np.testing.assert_allclose(
+            np.asarray(pp.apply({"params": converted}, tokens)),
+            np.asarray(flat.apply({"params": params}, tokens)),
+            atol=2e-5, rtol=2e-5,
+        )
+
+    def test_gpt2(self, mesh1):
+        # The registries' tiny sizes differ (gpt2=2L, gpt2_pp=4L): pin
+        # num_layers so both describe the same architecture.
+        self._logits_parity(
+            "gpt2", "gpt2_pp", mesh1,
+            size="tiny", num_layers=4, vocab_size=64, max_len=32,
+            flat_extra={"dropout_rate": 0.0},
+        )
+
+    def test_llama_untied(self, mesh1):
+        self._logits_parity(
+            "llama", "llama_pp", mesh1,
+            size="tiny", num_layers=4, vocab_size=64, max_len=32,
+        )
+
+    def test_indivisible_raises(self):
+        from distributeddeeplearning_tpu.hf_port import to_pipelined
+
+        with pytest.raises(ValueError, match="not divisible"):
+            to_pipelined({"h": {"block_0": {}, "block_1": {},
+                                "block_2": {}}}, num_stages=2)
+
+
 def test_llama_pp_tied_embeddings_parity(mesh1, mesh_factory):
     # Tied decoder through the pipelined stack, all three schedules vs the
     # sequential oracle (shared _train_losses harness).
