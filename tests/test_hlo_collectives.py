@@ -241,6 +241,21 @@ class TestConfigDrivenStrategies:
         assert sp["all-gather"] > 0, sp
 
 
+def test_megatron_sp_composes_with_flash(mesh1, mesh_factory):
+    # The shipped gpt2_owt config keeps attn_impl='flash' when the user
+    # flips train.sequence_parallel=true — the seq-over-tp activation
+    # rules must compose with the shard_map'd flash kernel, not just the
+    # xla core the HLO assert above uses.
+    from helpers import train_tiny_gpt2
+
+    single, _ = train_tiny_gpt2(mesh1)
+    sp_flash, _ = train_tiny_gpt2(
+        mesh_factory(dp=4, tp=2), attn_impl="flash",
+        rules=tp_rules(sequence_parallel=True),
+    )
+    np.testing.assert_allclose(single, sp_flash, rtol=2e-4)
+
+
 def test_activation_mesh_contextvar_enters_and_resets():
     # Pins the mechanism itself (set on entry, reset on exit, no leakage);
     # the end-to-end effect is covered by the collective tests above and
