@@ -33,6 +33,11 @@ _LABELS = {
         "—", "Llama-300M LM",
         "flash attn + fused AdamW + chunked head + ZeRO-1 (bf16)",
     ),
+    "decode:gpt2": (
+        "—", "GPT-2 124M decode",
+        "KV-cache generation: bulk prefill + one-token steps, greedy, "
+        "B=8 P=128 N=128",
+    ),
 }
 
 
