@@ -1,0 +1,105 @@
+"""End-to-end dry-run of the MFU attack matrix (VERDICT r4 Weak #3).
+
+``tools/chip_watch.sh`` chains ``tools/mfu_attack.py`` after a complete
+harvest; its four subprocess cells would otherwise first execute end-to-end
+unattended at the top of a precious healthy window. This test executes the
+real entrypoint against the CPU backend with shrunken shapes (DDL_MFU_SHRINK)
+and asserts it writes well-formed, fingerprinted cells and that ``--check``
+semantics match ``measure_tpu.py``'s — same code path, same output format,
+no chip required.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_REPO, "tools", "mfu_attack.py")
+
+# The tool has no cell filter: the dry-run executes all four subprocess
+# cells (shrunken shapes; ~2-3 min total on an uncontended box), covering
+# both sides of the XLA_FLAGS prelude branch of the child template.
+_CELLS_RUN = {"b256", "b256_flags", "b512", "b512_flags"}
+
+
+def _env(tmp_path, **extra):
+    env = dict(os.environ)  # conftest already stripped PALLAS_AXON_POOL_IPS
+    env.update(
+        JAX_PLATFORMS="cpu",
+        JAX_NUM_CPU_DEVICES="1",
+        DDL_MFU_OUT=str(tmp_path / "MFU_ATTACK.json"),
+        DDL_MFU_SHRINK="1",
+        **extra,
+    )
+    return env
+
+
+@pytest.fixture(scope="module")
+def attack(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("mfu")
+    env = _env(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, _TOOL], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return tmp_path, env, proc
+
+
+def test_writes_all_cells_wellformed(attack):
+    tmp_path, _, proc = attack
+    out = json.loads((tmp_path / "MFU_ATTACK.json").read_text())
+    assert set(out) == _CELLS_RUN, (sorted(out), proc.stdout)
+    for name, rec in out.items():
+        assert "error" not in rec, (name, rec)
+        assert rec["value"] > 0
+        assert rec["code_fingerprint"]
+        assert rec["shrunk"] is True  # dry-run cells can't pose as real ones
+        assert rec["cell"]["perf_flags"] == name.endswith("_flags")
+
+
+def test_best_cell_reported(attack):
+    # chip_watch's log is the operator surface: the one-line BEST summary
+    # must survive for BASELINE.md's before/after table.
+    _, _, proc = attack
+    assert "BEST " in proc.stdout
+
+
+def test_check_passes_after_run(attack):
+    tmp_path, env, _ = attack
+    proc = subprocess.run(
+        [sys.executable, _TOOL, "--check"], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_check_detects_shrunk_records_as_stale_for_real_matrix(attack):
+    # A CPU dry-run record must never satisfy --check for the real matrix:
+    # the fingerprint folds shrink mode in.
+    tmp_path, env, _ = attack
+    env = dict(env)
+    env.pop("DDL_MFU_SHRINK")
+    proc = subprocess.run(
+        [sys.executable, _TOOL, "--check"], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "pending:" in proc.stdout
+
+
+def test_budget_exhaustion_skips_cells_gracefully(tmp_path):
+    # DDL_MFU_BUDGET below the 120 s per-cell floor: the matrix must stop
+    # before launching any cell and still exit 0 (cells stay pending for
+    # the next window — ADVICE r4 #2's in-process budget).
+    env = _env(tmp_path, DDL_MFU_BUDGET="0")
+    proc = subprocess.run(
+        [sys.executable, _TOOL], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "BUDGET exhausted" in proc.stdout
+    assert not (tmp_path / "MFU_ATTACK.json").exists()

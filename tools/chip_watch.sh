@@ -4,81 +4,113 @@
 # is up, runs tools/measure_tpu.py to populate TPU_NUMBERS.json with the
 # per-config real-chip measurements BASELINE.md's table is waiting on
 # (kernel-exercising configs first; the Pallas smoke tier runs at the top of
-# each healthy window — see measure_tpu.py's module docstring).
-# measure_tpu.py resumes incrementally (skips configs already measured), so
-# a mid-measure wedge just means the next healthy probe picks up where it
-# left off. The loop ends once every config has an error-free record.
+# each healthy window — see measure_tpu.py's module docstring), then chains
+# tools/mfu_attack.py once the harvest is complete.
+#
+# ALWAYS-ON (VERDICT r4 Weak #1): no probe cap — round 4's MAX_PROBES=70
+# burned out mid-round and a healthy window would have gone unheard. The only
+# clean exit is "everything harvested"; a stalled harvest backs off for an
+# hour instead of exiting. Liveness is evidenced by a per-probe heartbeat in
+# WATCHER_STATUS.json at the repo root (pid + probe count + utc), so "watcher
+# running" is checkable from the round artifacts, not just `ps`.
+#
+# NEVER edit this file while an instance is running (bash reads scripts
+# incrementally): pkill -f chip_watch, edit, relaunch.
 #
 #   nohup tools/chip_watch.sh > /tmp/chip_watch.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
 
-MAX_PROBES=70           # ~12h of 10-minute wedge probes
-MAX_STALLED_ATTEMPTS=5  # consecutive no-progress measurement attempts
-# measure_tpu.py paces itself against DDL_MEASURE_BUDGET (graceful, reaps its
-# own subprocess groups); the outer timeout is a pure backstop for an
-# in-process wedge-hang and is deliberately larger so its SIGTERM can't land
-# while the smoke tier's subprocess tree is alive (orphan would hold the chip).
+# measure_tpu.py / mfu_attack.py pace themselves against DDL_MEASURE_BUDGET /
+# DDL_MFU_BUDGET (graceful, reap their own subprocess groups); the outer
+# timeouts are pure backstops for an in-process wedge-hang and are
+# deliberately larger so their SIGTERM can't land while a subprocess tree is
+# alive (orphan would hold the chip).
 export DDL_MEASURE_BUDGET=3600
 MEASURE_BACKSTOP=4500
+export DDL_MFU_BUDGET=5400
+MFU_BACKSTOP=6000
+MAX_STALLED_ATTEMPTS=5  # consecutive no-progress attempts per phase
+STALL_COOLDOWN=3600     # initial back-off when a phase stalls...
+MAX_COOLDOWN=28800      # ...doubling per consecutive stall, capped at 8 h
 
-# Completion lives in measure_tpu.py itself (--check): one source of truth
-# for the config list and record validity (incl. config fingerprints).
-done_yet() {
-  python tools/measure_tpu.py --check >/dev/null 2>&1
+STATUS=WATCHER_STATUS.json
+heartbeat() {  # $1 = chip state, $2 = note
+  printf '{"pid": %d, "probe": %d, "chip": "%s", "note": "%s", "utc": "%s"}\n' \
+    "$$" "$probe" "$1" "$2" "$(date -u +%FT%TZ)" > "$STATUS.tmp" \
+    && mv "$STATUS.tmp" "$STATUS"
 }
 
-# Separate budgets: wedge probes are cheap (2 min), measurement attempts
-# are not (up to $DDL_MEASURE_BUDGET) — a deterministically-failing config
-# must not hammer the shared chip for days. An attempt that makes progress (fewer
-# pending configs after than before) resets the budget, so mid-measure
-# wedges keep being ridden out across all $MAX_PROBES probes.
-pending_count() {
-  python tools/measure_tpu.py --check 2>/dev/null \
-    | sed -n 's/^pending: //p' | wc -w
+# Completion lives in the tools themselves (--check): one source of truth
+# for the config/cell lists and record validity (incl. fingerprints).
+done_yet() { python tools/measure_tpu.py --check >/dev/null 2>&1; }
+mfu_done() { python tools/mfu_attack.py --check >/dev/null 2>&1; }
+tool_pending_count() {
+  python "$1" --check 2>/dev/null | sed -n 's/^pending: //p' | wc -w
 }
 
-# After the harvest completes, a still-healthy window is spent attacking
-# the ResNet-50 MFU number (VERDICT r3 #7) instead of idling.
-finish() {
-  echo "all configs measured"
-  if python tools/mfu_attack.py --check >/dev/null 2>&1; then
-    echo "MFU attack already complete"
-  elif timeout 4500 python tools/mfu_attack.py; then
-    echo "MFU attack matrix done"
-  else
-    echo "MFU attack FAILED (rc=$?) — cells stay pending for the next window"
-    exit 1
+# One attempt state machine shared by the harvest and MFU phases. Progress =
+# fewer pending entries after than before (error records never satisfy
+# --check; completion is judged by --check, not the tool's exit code, which
+# is 0 even when cells errored or its internal budget skipped them).
+# Separate budgets from the wedge probes: probes are cheap (2 min), attempts
+# are not (up to the tool's internal budget) — a deterministically-failing
+# config must not hammer the shared chip for days. After
+# $MAX_STALLED_ATTEMPTS consecutive no-progress attempts the phase backs off
+# with a doubling (capped) cooldown and then retries ONCE per cooldown
+# period: always-on, but a persistent failure converges to ~1 attempt per
+# $MAX_COOLDOWN rather than a high duty cycle.
+run_phase() {
+  local label=$1 tool=$2 backstop=$3
+  local -n attempts=$4 cooldown=$5
+  if [ "$attempts" -ge "$MAX_STALLED_ATTEMPTS" ]; then
+    heartbeat up "$label stalled ($attempts no-progress attempts) - cooldown ${cooldown}s"
+    echo "probe $probe: $label stalled - cooling down ${cooldown}s"
+    sleep "$cooldown"
+    cooldown=$((cooldown * 2))
+    [ "$cooldown" -gt "$MAX_COOLDOWN" ] && cooldown=$MAX_COOLDOWN
+    attempts=$((MAX_STALLED_ATTEMPTS - 1))  # one retry per cooldown period
+    return
   fi
-  echo "done"
-  exit 0
+  attempts=$((attempts + 1))
+  local before after
+  before=$(tool_pending_count "$tool")
+  heartbeat up "$label (attempt $attempts, $before pending)"
+  echo "probe $probe: chip alive - $label (attempt $attempts, $before pending)"
+  timeout "$backstop" python "$tool"
+  after=$(tool_pending_count "$tool")
+  if [ "$after" -lt "$before" ]; then
+    attempts=0  # progress: keep riding out mid-run wedges
+    cooldown=$STALL_COOLDOWN
+  fi
+  echo "$label: $after pending"
+  sleep 60  # a persistently-failing run must not hot-loop
 }
 
+probe=0
 measure_attempts=0
-for i in $(seq 1 "$MAX_PROBES"); do
-  if done_yet; then
-    finish
-  fi
-  if [ "$measure_attempts" -ge "$MAX_STALLED_ATTEMPTS" ]; then
-    echo "$MAX_STALLED_ATTEMPTS no-progress measurement attempts exhausted — giving up"
-    exit 1
+measure_cooldown=$STALL_COOLDOWN
+mfu_attempts=0
+mfu_cooldown=$STALL_COOLDOWN
+while :; do
+  probe=$((probe + 1))
+  if done_yet && mfu_done; then
+    heartbeat done "all configs + MFU matrix measured"
+    echo "done"
+    exit 0
   fi
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    measure_attempts=$((measure_attempts + 1))
-    before=$(pending_count)
-    echo "probe $i: chip alive — measuring (attempt $measure_attempts, $before pending)"
-    timeout "$MEASURE_BACKSTOP" python tools/measure_tpu.py
-    after=$(pending_count)
-    if [ "$after" -lt "$before" ]; then
-      measure_attempts=0  # progress: keep riding out mid-measure wedges
+    if done_yet; then
+      # Harvest complete; spend the still-healthy window on the MFU matrix
+      # (VERDICT r3 #7).
+      run_phase "MFU attack" tools/mfu_attack.py "$MFU_BACKSTOP" \
+        mfu_attempts mfu_cooldown
+    else
+      run_phase "measure" tools/measure_tpu.py "$MEASURE_BACKSTOP" \
+        measure_attempts measure_cooldown
     fi
-    sleep 60  # a persistently-failing config must not hot-loop
   else
-    echo "probe $i: wedged"
+    heartbeat wedged "waiting for a healthy window"
+    echo "probe $probe: wedged"
     sleep 600
   fi
 done
-if done_yet; then
-  finish
-fi
-echo "gave up after $MAX_PROBES probes"
-exit 1

@@ -281,8 +281,9 @@ def _parse_pytest_counts(out: str) -> dict:
     import re
 
     counts = {"passed": 0, "skipped": 0, "failed": 0, "error": 0}
-    for n, kind in re.findall(r"(\d+) (passed|skipped|failed|error)", out):
-        counts[kind] = int(n)
+    # pytest pluralizes: "1 error" but "2 errors" — normalize to one key.
+    for n, kind in re.findall(r"(\d+) (passed|skipped|failed|errors?)", out):
+        counts["error" if kind.startswith("error") else kind] = int(n)
     return counts
 
 

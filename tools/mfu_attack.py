@@ -20,6 +20,13 @@ iff every cell has a record for the current code. ``chip_watch.sh`` chains
 this after a complete harvest, so a long healthy window fills BASELINE.md's
 before/after table without an operator.
 
+Budget (ADVICE r4 #2): the matrix paces itself against DDL_MFU_BUDGET
+seconds (default 5400) the same way measure_tpu.py paces against
+DDL_MEASURE_BUDGET — the deadline is checked between cells and caps each
+cell's subprocess timeout, so chip_watch.sh's outer timeout is a pure
+backstop for an in-process hang, never the mechanism that ends a healthy
+run mid-matrix.
+
 CPU dry-run (same de-risking as measure_tpu):
   DDL_MEASURE_OUT-style knobs: DDL_MFU_OUT (output path), DDL_MFU_SHRINK=1
   (tiny shapes/steps).
@@ -38,6 +45,10 @@ sys.path.insert(0, _REPO)
 
 _OUT = os.environ.get("DDL_MFU_OUT", os.path.join(_REPO, "MFU_ATTACK.json"))
 _SHRINK = os.environ.get("DDL_MFU_SHRINK") == "1"
+# Per-cell subprocess ceiling; the shared DDL_MFU_BUDGET deadline caps it
+# further as the matrix burns time (worst case 4 cells x _CELL_TIMEOUT would
+# otherwise exceed any sane outer backstop).
+_CELL_TIMEOUT = 1500
 
 # (cell name, batch, perf_flags)
 CELLS = [
@@ -64,9 +75,14 @@ def _code_fp() -> str:
     import hashlib
 
     h = hashlib.sha256()
+    # train.py and the config file are part of what a cell MEASURES (the
+    # same staleness class ADVICE r3 #1 fixed in measure_tpu._fingerprint):
+    # an edit to either must invalidate old cells.
     for rel in ("distributeddeeplearning_tpu/benchmark.py",
                 "distributeddeeplearning_tpu/models/resnet.py",
-                "distributeddeeplearning_tpu/mesh.py"):
+                "distributeddeeplearning_tpu/mesh.py",
+                "distributeddeeplearning_tpu/train.py",
+                "configs/resnet50_imagenet.py"):
         with open(os.path.join(_REPO, rel), "rb") as f:
             h.update(f.read())
     # Shrink mode changes what a record MEASURES: a CPU dry-run record must
@@ -101,7 +117,7 @@ def check() -> int:
     return 0
 
 
-def run_cell(name: str, batch: int, flags: bool) -> dict:
+def run_cell(name: str, batch: int, flags: bool, timeout: int = _CELL_TIMEOUT) -> dict:
     overrides = [f"data.batch_size={batch}"]
     warmup, steps = 5, 20
     if _SHRINK:
@@ -148,7 +164,7 @@ def run_cell(name: str, batch: int, flags: bool) -> dict:
     old_term = signal.signal(signal.SIGTERM, _reap)
     atexit.register(_reap)
     try:
-        out, _ = proc.communicate(timeout=1500)
+        out, _ = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         _reap()
         proc.communicate()  # reap the SIGKILLed child (no zombie per cell)
@@ -167,16 +183,38 @@ def run_cell(name: str, batch: int, flags: bool) -> dict:
 
 
 def main() -> int:
+    deadline = time.time() + int(os.environ.get("DDL_MFU_BUDGET", "5400"))
+    # Launching a full-size cell with less than its expected runtime left
+    # just burns healthy-window time on a doomed run (SIGKILL mid-cell, a
+    # misleading "timed out" record) — break between cells instead, like
+    # measure_tpu. Shrunk cells finish in seconds, so a small floor is fine.
+    floor = 120 if _SHRINK else _CELL_TIMEOUT
     out = _load()
     for name, batch, flags in CELLS:
         if _current(out.get(name)):
             print("SKIP", name, flush=True)
             continue
+        remaining = int(deadline - time.time())
+        if remaining < floor:
+            print("BUDGET exhausted — remaining cells stay pending for the "
+                  "next window", flush=True)
+            break
         print("CELL", name, flush=True)
-        rec = run_cell(name, batch, flags)
+        rec = run_cell(name, batch, flags, timeout=min(_CELL_TIMEOUT, remaining))
         if "error" not in rec:
             rec["code_fingerprint"] = _code_fp()
             rec["utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        else:
+            # A stale-but-real prior measurement beats nothing: carry it
+            # forward under "previous" (incl. across repeated errors), same
+            # recovery contract as measure_tpu.
+            prior = out.get(name)
+            if isinstance(prior, dict) and "error" not in prior and prior:
+                rec["previous"] = prior
+            elif isinstance(prior, dict) and isinstance(
+                prior.get("previous"), dict
+            ):
+                rec["previous"] = prior["previous"]
         out[name] = rec
         tmp = _OUT + ".tmp"
         with open(tmp, "w") as f:
