@@ -109,3 +109,27 @@ def test_kernel_configs_harvested_first():
     kernel = {"gpt2_owt", "bert_mlm", "vit_imagenet21k", "llama_lm"}
     first = order[: len(kernel)]
     assert set(first) == kernel, order
+
+
+def test_decode_row_reports_decode_only_rate(tmp_path):
+    """The decode:gpt2 harvest row must carry the split-stage metrics
+    (VERDICT r4 Weak #2): headline = generated tokens / decode-loop time,
+    prefill as a separate field."""
+    env = _env(tmp_path, DDL_MEASURE_SKIP_SMOKE="1")
+    env["DDL_MEASURE_ONLY"] = "decode:gpt2"
+    proc = subprocess.run(
+        [sys.executable, _TOOL], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads((tmp_path / "TPU_NUMBERS.json").read_text())["decode:gpt2"]
+    assert "error" not in rec
+    assert rec["unit"] == "gen-tokens/sec/chip"
+    assert rec["value"] > 0
+    # Shrink shapes: batch 2, max_new 8, bulk prefill -> the scan generates
+    # 7 tokens/row; prompt tokens only in the prefill/e2e fields.
+    assert rec["generated_tokens"] == 2 * 7
+    assert rec["prompt_tokens"] == 2 * 16
+    assert rec["reps"] == 3
+    assert rec["prefill_tokens_per_sec"] > 0
+    assert rec["config_fingerprint"]
