@@ -217,6 +217,10 @@ class PipelinedTransformerStack(nn.Module):
         use_pipeline = (
             self.pipeline and self.mesh is not None and self.mesh.shape["pp"] > 1
         )
+        # pp x ep / pp x cp are fenced at Trainer build time (train.py
+        # composition fences) — the engine composes with dp/fsdp/tp/zero1
+        # only, because pipeline_value_and_grad owns its own
+        # differentiation and stages contain no expert dispatch or KV ring.
         # The GPipe body microbatches the per-device batch shard, so validate
         # the local (post dp/fsdp split) size, not the global one.
         local_batch = x.shape[0]

