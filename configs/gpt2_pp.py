@@ -31,6 +31,11 @@ def get_config() -> Config:
             kwargs={
                 "size": "124m",
                 "max_len": 1024,
+                # Megatron-style padded vocab: the wte table (tied head) is
+                # stored sharded over (tp, pp) ('vocab_pp') and 50257 does
+                # not divide pp=4 — 50304 (the standard GPT-2 padding) does.
+                # Data token ids stay < 50257; the pad rows are dead weights.
+                "vocab_size": 50304,
                 "num_stages": 4,
                 "num_microbatches": num_microbatches,
                 "schedule": "1f1b_interleaved",

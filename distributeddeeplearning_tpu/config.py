@@ -35,6 +35,9 @@ class DataConfig:
     vocab_size: int = 1024
     mask_prob: float = 0.15  # MLM kinds: fraction of positions masked
     mask_token_id: int = 3  # MLM kinds: the [MASK] id
+    # synthetic_mlm: >0 emits variable-length padded rows + attention_mask
+    # (the padded-batch BERT workload; see data.SyntheticMLM.pad_min_len).
+    pad_min_len: int = 0
     n_distinct: int = 8
     seed: int = 0
     # Held-out eval split. Synthetic kinds: ``eval_seed`` >= 0 draws eval

@@ -172,6 +172,12 @@ class SyntheticMLM(IndexedDataset):
     mask_token_id: int = 3
     seed: int = 0
     n_distinct: int = 8
+    # >0: variable-length rows — each sample's true length is drawn uniformly
+    # from [pad_min_len, seq_len], the tail is pad token 0 with an
+    # ``attention_mask`` of 0 and label -1 (outside the loss). This is the
+    # padded-batch BERT workload shape (the reference's wiki MLM batches);
+    # ``mlm_task`` feeds the mask to the model as the key-padding mask.
+    pad_min_len: int = 0
 
     def batch(self, index: int) -> dict[str, np.ndarray]:
         if self.n_distinct:
@@ -183,7 +189,24 @@ class SyntheticMLM(IndexedDataset):
         masked = rng.random(tokens.shape) < self.mask_prob
         inputs = np.where(masked, np.int32(self.mask_token_id), tokens)
         labels = np.where(masked, tokens, np.int32(-1))
-        return {"input_tokens": inputs, "labels": labels}
+        if not self.pad_min_len:
+            return {"input_tokens": inputs, "labels": labels}
+        if not 0 < self.pad_min_len <= self.seq_len:
+            raise ValueError(
+                f"pad_min_len={self.pad_min_len} must be in [1, "
+                f"seq_len={self.seq_len}]"
+            )
+        lens = rng.integers(
+            self.pad_min_len, self.seq_len + 1, (self.batch_size,)
+        )
+        attn = (
+            np.arange(self.seq_len)[None, :] < lens[:, None]
+        ).astype(np.int32)
+        return {
+            "input_tokens": np.where(attn.astype(bool), inputs, np.int32(0)),
+            "labels": np.where(attn.astype(bool), labels, np.int32(-1)),
+            "attention_mask": attn,
+        }
 
 
 # Single registry: config.dataset_kwargs derives its field intersection from

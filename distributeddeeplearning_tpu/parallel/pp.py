@@ -73,18 +73,43 @@ def check_pipeline_shapes(
         )
 
 
-def _gpipe_local(stage_fn, params, x, *, axis_name: str, num_microbatches: int):
+def _microbatch(t, num_microbatches):
+    """Reshape a [local_batch, ...] array to [M, local_batch/M, ...]."""
+    return t.reshape(
+        (num_microbatches, t.shape[0] // num_microbatches) + t.shape[1:]
+    )
+
+
+def _stage_apply(stage_fn, params, x, extra_mb, m_idx):
+    """Run one stage on one microbatch's activations. ``extra_mb`` is the
+    microbatched per-sample side input (key-padding mask) replicated over
+    ``pp`` — every device holds ALL microbatches' rows, so the stage just
+    gathers slot ``m_idx`` (the microbatch it is processing this tick)
+    locally; unlike activations, the mask never rides the ppermute ring."""
+    if extra_mb is None:
+        return stage_fn(params, x)
+    return stage_fn(params, x, jax.tree.map(lambda e: e[m_idx], extra_mb))
+
+
+def _gpipe_local(
+    stage_fn, params, x, *, axis_name: str, num_microbatches: int, extra=None
+):
     """Per-device GPipe time loop (runs inside shard_map).
 
     params: this device's stage slice, leading dim 1 (squeezed here).
     x: [local_batch, ...] — the full local batch (replicated over ``pp``).
+    extra: optional pytree of [local_batch, ...] per-sample side inputs
+    (key-padding mask) handed to ``stage_fn(params, x, extra_mb)``.
     Returns the last stage's outputs for every microbatch, [local_batch, ...].
     """
     S = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     M = num_microbatches
     params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
-    mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+    mb = _microbatch(x, M)
+    emb = None if extra is None else jax.tree.map(
+        lambda t: _microbatch(t, M), extra
+    )
 
     # Activation shape/dtype are stage-invariant (residual blocks), so one
     # rotating buffer + one output accumulator suffice. x is replicated over
@@ -98,7 +123,10 @@ def _gpipe_local(stage_fn, params, x, *, axis_name: str, num_microbatches: int):
     def tick(carry, t):
         state_in, outputs = carry
         x_in = jnp.where(stage == 0, mb[jnp.minimum(t, M - 1)], state_in)
-        y = stage_fn(params, x_in)
+        # Microbatch this stage processes at tick t (clipped in the bubble,
+        # where the compute is garbage anyway).
+        m_idx = jnp.clip(t - stage, 0, M - 1)
+        y = _stage_apply(stage_fn, params, x_in, emb, m_idx)
         out_t = t - (S - 1)  # which microbatch the LAST stage just finished
         # Single-slot masked write keeps the scan carry in place.
         out_i = jnp.clip(out_t, 0, M - 1)
@@ -123,14 +151,19 @@ def gpipe_bubble_fraction(num_microbatches: int, num_stages: int) -> float:
     return (num_stages - 1) / (num_microbatches + num_stages - 1)
 
 
-def _pp_local_fwd(stage_fn, params, x, *, axis_name, num_microbatches):
+def _pp_local_fwd(
+    stage_fn, params, x, *, axis_name, num_microbatches, extra=None
+):
     """GPipe forward tick loop that ALSO stashes each stage's per-microbatch
     input (the 1F1B backward residuals). Returns (outputs, stash)."""
     S = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     M = num_microbatches
     params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
-    mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+    mb = _microbatch(x, M)
+    emb = None if extra is None else jax.tree.map(
+        lambda t: _microbatch(t, M), extra
+    )
 
     buf0 = jax.lax.pcast(jnp.zeros_like(mb[0]), (axis_name,), to="varying")
     out0 = jax.lax.pcast(jnp.zeros_like(mb), (axis_name,), to="varying")
@@ -146,7 +179,7 @@ def _pp_local_fwd(stage_fn, params, x, *, axis_name, num_microbatches):
         # Single-slot masked writes (not whole-buffer selects) keep the scan
         # carry updating in place.
         stash = stash.at[m_idx].set(jnp.where(valid, x_in, stash[m_idx]))
-        y = stage_fn(params, x_in)
+        y = _stage_apply(stage_fn, params, x_in, emb, m_idx)
         out_i = jnp.clip(t - (S - 1), 0, M - 1)
         out_ok = (stage == S - 1) & (t - (S - 1) >= 0)
         outputs = outputs.at[out_i].set(
@@ -165,7 +198,9 @@ def _pp_local_fwd(stage_fn, params, x, *, axis_name, num_microbatches):
     return outputs.reshape(x.shape), stash
 
 
-def _pp_local_bwd(stage_fn, params, stash, g, *, axis_name, num_microbatches):
+def _pp_local_bwd(
+    stage_fn, params, stash, g, *, axis_name, num_microbatches, extra=None
+):
     """Reverse (1F1B-ordered) pipeline: stage ``s`` runs the backward of
     microbatch ``m`` at tick ``(S-1-s) + (M-1-m)``, recomputing the stage
     forward from the stashed input and handing the input-cotangent one hop
@@ -175,7 +210,10 @@ def _pp_local_bwd(stage_fn, params, stash, g, *, axis_name, num_microbatches):
     stage = jax.lax.axis_index(axis_name)
     M = num_microbatches
     params_sq = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
-    gmb = g.reshape((M, g.shape[0] // M) + g.shape[1:])
+    gmb = _microbatch(g, M)
+    emb = None if extra is None else jax.tree.map(
+        lambda t: _microbatch(t, M), extra
+    )
 
     # params/stash/g are all already pp-varying here (params via in_specs,
     # stash as a fwd residual, g via the psum transpose), so plain zeros_like
@@ -194,8 +232,12 @@ def _pp_local_bwd(stage_fn, params, stash, g, *, axis_name, num_microbatches):
         g_in = jnp.where(stage == S - 1, gmb[m_idx], recv)
         x_in = stash[m_idx]
         # Recompute the stage forward (1F1B-with-remat): the vjp sees only
-        # one microbatch's activations at a time.
-        _, vjp_fn = jax.vjp(stage_fn, params_sq, x_in)
+        # one microbatch's activations at a time. The mask (if any) is a
+        # non-differentiated side input — closed over, not a vjp operand.
+        _, vjp_fn = jax.vjp(
+            lambda p, xx: _stage_apply(stage_fn, p, xx, emb, m_idx),
+            params_sq, x_in,
+        )
         dp, dxi = vjp_fn(g_in)
         dparams = jax.tree.map(
             lambda a, b: a + jnp.where(valid, b, jnp.zeros_like(b)),
@@ -228,6 +270,7 @@ def one_f_one_b(
     num_microbatches: int,
     axis_name: str = "pp",
     param_specs=None,
+    extra=None,
 ):
     """Drop-in for :func:`gpipe` with the 1F1B backward schedule.
 
@@ -241,51 +284,69 @@ def one_f_one_b(
     (default ``P('pp')`` on the leading stage dim). PP×TP passes specs that
     additionally shard heads/mlp dims over ``tp``; the stage_fn is then
     responsible for the tp boundary psums (see ``models/pipeline.py``).
+
+    ``extra``: optional pytree of per-sample side inputs ([local_batch, ...],
+    e.g. a key-padding mask) passed through to ``stage_fn(params, x, extra)``
+    per microbatch; not differentiated (its cotangent is zero).
     """
     S = mesh.shape[axis_name]
     if param_specs is None:
         param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     x_spec = P(BATCH_AXES)
     if S == 1:
-        return sequential(stage_fn, stacked_params, x)
+        return sequential(stage_fn, stacked_params, x, extra=extra)
 
+    # ``e`` rides through the custom_vjp as an operand pytree (None when
+    # unused — an empty pytree, so both arities share one code path) with a
+    # zero cotangent: masks are data, not parameters.
     @jax.custom_vjp
-    def core(params, x):
+    def core(params, x, e):
         out, _ = _pp_local_fwd(
             stage_fn, params, x,
-            axis_name=axis_name, num_microbatches=num_microbatches,
+            axis_name=axis_name, num_microbatches=num_microbatches, extra=e,
         )
         return out
 
-    def core_fwd(params, x):
+    def core_fwd(params, x, e):
         out, stash = _pp_local_fwd(
             stage_fn, params, x,
-            axis_name=axis_name, num_microbatches=num_microbatches,
+            axis_name=axis_name, num_microbatches=num_microbatches, extra=e,
         )
-        return out, (params, stash)
+        return out, (params, stash, e)
 
     def core_bwd(res, g):
-        params, stash = res
-        return _pp_local_bwd(
+        params, stash, e = res
+        dparams, dx = _pp_local_bwd(
             stage_fn, params, stash, g,
-            axis_name=axis_name, num_microbatches=num_microbatches,
+            axis_name=axis_name, num_microbatches=num_microbatches, extra=e,
         )
+        return dparams, dx, jax.tree.map(jnp.zeros_like, e)
 
     core.defvjp(core_fwd, core_bwd)
 
-    def local(params, x):
+    def local(params, x, e=None):
         # core's output is pp-varying (last stage real, zeros elsewhere);
         # psum here — outside the custom_vjp — is the broadcast, and its
         # transpose hands the full output cotangent to every stage.
-        return jax.lax.psum(core(params, x), axis_name)
+        return jax.lax.psum(core(params, x, e), axis_name)
 
+    if extra is None:
+        fn = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(param_specs, x_spec),
+            out_specs=x_spec,
+        )
+        return fn(stacked_params, x)
     fn = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, x_spec),
+        in_specs=(
+            param_specs, x_spec, jax.tree.map(lambda _: x_spec, extra)
+        ),
         out_specs=x_spec,
     )
-    return fn(stacked_params, x)
+    return fn(stacked_params, x, extra)
 
 
 def interleaved_1f1b(
@@ -534,15 +595,20 @@ def gpipe(
     num_microbatches: int,
     axis_name: str = "pp",
     param_specs=None,
+    extra=None,
 ):
     """Apply ``S`` stages to ``x`` as a GPipe pipeline over ``axis_name``.
 
     stage_fn: ``(stage_params, activations) -> activations`` for ONE stage
-        (shape/dtype-preserving).
+        (shape/dtype-preserving); with ``extra``,
+        ``(stage_params, activations, extra_mb) -> activations``.
     stacked_params: pytree with leaves ``[S, ...]`` — stage-stacked weights,
         sharded ``P('pp')`` on the leading dim (logical axis ``stage``).
     x: ``[global_batch, ...]`` sharded over ``BATCH_AXES``.
     param_specs: optional per-leaf specs (PP×TP; see :func:`one_f_one_b`).
+    extra: optional pytree of per-sample side inputs ([global_batch, ...],
+        e.g. a key-padding mask), batch-sharded like ``x`` and microbatched
+        in lockstep with it (see :func:`_stage_apply`).
 
     Returns stage_{S-1}(... stage_0(x)), sharded like ``x``.
     """
@@ -552,25 +618,42 @@ def gpipe(
     x_spec = P(BATCH_AXES)
     if S == 1:
         # Degenerate ring: identical math to the sequential oracle.
-        return sequential(stage_fn, stacked_params, x)
+        return sequential(stage_fn, stacked_params, x, extra=extra)
+    if extra is None:
+        fn = jax.shard_map(
+            lambda p, x: _gpipe_local(
+                stage_fn, p, x,
+                axis_name=axis_name, num_microbatches=num_microbatches,
+            ),
+            mesh=mesh,
+            in_specs=(param_specs, x_spec),
+            out_specs=x_spec,
+        )
+        return fn(stacked_params, x)
     fn = jax.shard_map(
-        lambda p, x: _gpipe_local(
-            stage_fn, p, x, axis_name=axis_name, num_microbatches=num_microbatches
+        lambda p, x, e: _gpipe_local(
+            stage_fn, p, x,
+            axis_name=axis_name, num_microbatches=num_microbatches, extra=e,
         ),
         mesh=mesh,
-        in_specs=(param_specs, x_spec),
+        in_specs=(
+            param_specs, x_spec, jax.tree.map(lambda _: x_spec, extra)
+        ),
         out_specs=x_spec,
     )
-    return fn(stacked_params, x)
+    return fn(stacked_params, x, extra)
 
 
-def sequential(stage_fn, stacked_params, x):
+def sequential(stage_fn, stacked_params, x, extra=None):
     """The pipeline's correctness oracle: the same stacked stages applied
     back-to-back with a ``lax.scan`` (the idiomatic single-device execution
-    of stage-stacked weights)."""
+    of stage-stacked weights). ``extra`` (key-padding mask) is identical for
+    every stage — no microbatching in this path."""
 
     def body(y, stage_params):
-        return stage_fn(stage_params, y), None
+        if extra is None:
+            return stage_fn(stage_params, y), None
+        return stage_fn(stage_params, y, extra), None
 
     y, _ = jax.lax.scan(body, x, stacked_params)
     return y

@@ -128,7 +128,17 @@ def lm_task(head_chunk: int = 128) -> Task:
 
 
 def mlm_task(head_chunk: int = 128) -> Task:
-    """Masked LM: loss only on masked positions (labels == -1 is ignored)."""
+    """Masked LM: loss only on masked positions (labels == -1 is ignored).
+
+    Padded batches: when the dataset emits an ``attention_mask`` (e.g.
+    ``synthetic_mlm`` with ``pad_min_len``), it is fed to the model as the
+    key-padding mask; padding positions carry label -1, so they are already
+    outside the loss."""
+
+    def input_fn(batch):
+        if "attention_mask" in batch:
+            return (batch["input_tokens"], batch["attention_mask"])
+        return (batch["input_tokens"],)
 
     def loss_fn(out, batch):
         labels = batch["labels"]
@@ -137,7 +147,7 @@ def mlm_task(head_chunk: int = 128) -> Task:
         loss = (per_tok * weights).sum() / jnp.maximum(weights.sum(), 1.0)
         return loss, {"loss": loss, "masked_fraction": weights.mean()}
 
-    return Task(input_fn=lambda b: (b["input_tokens"],), loss_fn=loss_fn)
+    return Task(input_fn=input_fn, loss_fn=loss_fn)
 
 
 def get_task(name: str, **task_kwargs) -> Task:

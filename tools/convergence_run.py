@@ -11,16 +11,28 @@ Dataset: this environment has no real CIFAR-10 and zero egress (SURVEY §0),
 so the run uses "synthcifar" — a PROCEDURALLY GENERATED 10-class 32x32x3
 task, written in the exact CIFAR-10 binary layout. Each class is a fixed
 low-frequency color pattern; each sample randomizes translation, contrast,
-brightness, adds a low-weight distractor from another class and strong
-pixel noise, then quantizes to uint8. Samples are pure functions of
+brightness, adds a distractor blend from another class and strong pixel
+noise, then quantizes to uint8. Samples are pure functions of
 (split seed, index), eval draws from a disjoint index range, and chance is
 10% — so the >=60% bar is evidence the whole recipe wiring learns, which is
 what BASELINE.json:2's "top-1 parity" machinery needs validated (the real-
 data number itself needs real data and hardware).
 
+RECIPE-SENSITIVE (VERDICT r4 #5): round 3's artifact saturated its own bar
+(0.9995 vs 0.60 on 8192 clean records), proving wiring but not that the
+recipe components are load-bearing. The task is now hardened — 2048 train
+records (the eval split stays at 2048) and 10% symmetric label noise on the
+TRAIN split only — so ~37 epochs of a 600-step budget put real overfitting
+pressure on the run, and the artifact carries a SECOND leg trained with
+in-loader augmentation disabled that must land measurably below the full
+recipe (``tests/test_convergence.py`` asserts the gap). Label noise caps
+honest train accuracy near 90% while held-out eval stays clean, so the
+margin over the bar measures generalization, not memorization headroom.
+
 Usage:
-    python tools/convergence_run.py              # generate + train + write
-    python tools/convergence_run.py --steps 800  # shorter budget
+    python tools/convergence_run.py              # both legs + write artifact
+    python tools/convergence_run.py --steps 800  # different budget
+    python tools/convergence_run.py --skip-ablation   # main leg only
 """
 
 from __future__ import annotations
@@ -39,8 +51,9 @@ sys.path.insert(0, _REPO)
 
 N_CLASSES = 10
 SIZE = 32
-TRAIN_N = 8192
+TRAIN_N = 2048  # small on purpose: ~37 epochs/600 steps -> overfit pressure
 EVAL_N = 2048
+LABEL_NOISE = 0.10  # train split only; eval labels are clean
 ACCURACY_BAR = 0.60
 # DDL_CONV_OUT: alternate artifact path (smoke/dry runs must not clobber
 # the committed artifact).
@@ -87,23 +100,30 @@ def make_sample(templates, label: int, rng) -> np.ndarray:
         templates[other],
         (rng.integers(0, SIZE), rng.integers(0, SIZE)), axis=(0, 1),
     )
-    w = rng.uniform(0.0, 0.35)
+    w = rng.uniform(0.0, 0.45)
     img = (1 - w) * img + w * dis
     img = (img - 0.5) * rng.uniform(0.6, 1.4) + 0.5 + rng.uniform(-0.15, 0.15)
-    img = img + rng.normal(0.0, 0.18, img.shape).astype(np.float32)
+    img = img + rng.normal(0.0, 0.22, img.shape).astype(np.float32)
     return (np.clip(img, 0, 1) * 255).astype(np.uint8)
 
 
-def write_split(path: str, n: int, seed: int) -> str:
+def write_split(path: str, n: int, seed: int, label_noise: float = 0.0) -> str:
     """CIFAR-10-binary records (1 label byte + chw payload); returns a
-    sha256 of the file for artifact provenance."""
+    sha256 of the file for artifact provenance. ``label_noise`` replaces
+    that fraction of STORED labels with a uniform class (the image is still
+    generated from the true label) — symmetric noise the recipe has to
+    avoid memorizing."""
     templates = class_templates()
     rng = np.random.default_rng(seed)
     with open(path, "wb") as f:
         for i in range(n):
             label = i % N_CLASSES  # balanced
             img = make_sample(templates, label, rng)
-            f.write(bytes([label]))
+            stored = (
+                int(rng.integers(0, N_CLASSES))
+                if rng.random() < label_noise else label
+            )
+            f.write(bytes([stored]))
             f.write(img.transpose(2, 0, 1).tobytes())  # chw, CIFAR layout
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -111,18 +131,15 @@ def write_split(path: str, n: int, seed: int) -> str:
     return h.hexdigest()[:16]
 
 
-def run(steps: int, out_dir: str) -> dict:
+def run(steps: int, out_dir: str, train_path: str, eval_path: str,
+        augment: bool = True, resume_leg: bool = True) -> dict:
+    """One training leg over pre-generated split files. ``augment=False``
+    is the ablation: identical data bytes, identical budget, in-loader
+    augmentation off — the recipe-sensitivity control."""
     from distributeddeeplearning_tpu.cli import build_all, make_eval_fn
     from distributeddeeplearning_tpu.config import apply_overrides, load_config
     from distributeddeeplearning_tpu.data import prefetch, sharded_batches
     from distributeddeeplearning_tpu.train import fit
-
-    train_path = os.path.join(out_dir, "synthcifar_train.bin")
-    eval_path = os.path.join(out_dir, "synthcifar_eval.bin")
-    t0 = time.time()
-    train_sha = write_split(train_path, TRAIN_N, seed=1)
-    eval_sha = write_split(eval_path, EVAL_N, seed=2)  # disjoint draw
-    gen_s = round(time.time() - t0, 1)
 
     from distributeddeeplearning_tpu.checkpoint import CheckpointManager
     from distributeddeeplearning_tpu.train import evaluate
@@ -134,7 +151,7 @@ def run(steps: int, out_dir: str) -> dict:
         "data.kind=record_file_image",
         f"data.path={train_path}",
         f"data.eval_path={eval_path}",
-        "data.augment=True",
+        f"data.augment={augment}",
         "data.batch_size=128",
         f"train.steps={steps}",
         "train.label_smoothing=0.1",
@@ -185,6 +202,18 @@ def run(steps: int, out_dir: str) -> dict:
     final_acc = evals[-1]["eval_accuracy"] if evals else 0.0
     best_acc = max((h["eval_accuracy"] for h in evals), default=0.0)
 
+    record = {
+        "augment": augment,
+        "steps": cfg.train.steps,
+        "global_batch": cfg.data.batch_size,
+        "final_eval_accuracy": round(final_acc, 4),
+        "best_eval_accuracy": round(best_acc, 4),
+        "train_seconds": train_s,
+        "history": history,
+    }
+    if not resume_leg:
+        return record
+
     # Resume leg (the recipe's LAST unvalidated wire): a FRESH build_all +
     # restore of the final checkpoint must reproduce the same held-out
     # accuracy — exercising the orbax restore path at real (not toy) state
@@ -198,46 +227,70 @@ def run(steps: int, out_dir: str) -> dict:
     resumed_step = int(state2.step)
     print(json.dumps({"resumed_step": resumed_step,
                       "resumed_eval_accuracy": resumed_acc}), flush=True)
-    return {
-        "task": "synthcifar-10 (procedural; no real CIFAR-10 in this "
-                "environment — see module docstring)",
+    record["resumed_step"] = resumed_step
+    record["resumed_eval_accuracy"] = round(resumed_acc, 4)
+    return record
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=600)  # ~37 epochs @ 2048
+    ap.add_argument("--out-dir", default="/tmp/synthcifar")
+    ap.add_argument("--skip-ablation", action="store_true",
+                    help="main (augmented) leg only")
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    train_path = os.path.join(args.out_dir, "synthcifar_train.bin")
+    eval_path = os.path.join(args.out_dir, "synthcifar_eval.bin")
+    t0 = time.time()
+    train_sha = write_split(train_path, TRAIN_N, seed=1,
+                            label_noise=LABEL_NOISE)
+    eval_sha = write_split(eval_path, EVAL_N, seed=2)  # disjoint draw, clean
+    gen_s = round(time.time() - t0, 1)
+
+    main_leg = run(args.steps, os.path.join(args.out_dir, "main"),
+                   train_path, eval_path, augment=True, resume_leg=True)
+    record = {
+        "task": "synthcifar-10 hardened (procedural; no real CIFAR-10 in "
+                "this environment — see module docstring)",
         "recipe": "record_file_image + C++ loader augmentation + label "
                   "smoothing 0.1 + cosine schedule + no-decay-on-BN/bias",
         "model": "resnet18 width=32 stem=cifar",
         "train_records": TRAIN_N,
         "eval_records": EVAL_N,
+        "label_noise": LABEL_NOISE,
         "train_file_sha256_16": train_sha,
         "eval_file_sha256_16": eval_sha,
-        "steps": cfg.train.steps,
-        "global_batch": cfg.data.batch_size,
         "accuracy_bar": ACCURACY_BAR,
-        "final_eval_accuracy": round(final_acc, 4),
-        "best_eval_accuracy": round(best_acc, 4),
-        "resumed_step": resumed_step,
-        "resumed_eval_accuracy": round(resumed_acc, 4),
-        "bar_met": bool(final_acc >= ACCURACY_BAR),
         "chance_accuracy": 1.0 / N_CLASSES,
         "platform": "cpu-sim dp8",
         "gen_seconds": gen_s,
-        "train_seconds": train_s,
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "history": history,
+        **main_leg,
+        "bar_met": bool(main_leg["final_eval_accuracy"] >= ACCURACY_BAR),
     }
+    del record["augment"]  # the top level IS the augmented recipe
 
+    if not args.skip_ablation:
+        # Ablation control: SAME data bytes, SAME budget, augmentation off.
+        # Must land measurably below the full recipe — the evidence that
+        # the augmentation component is load-bearing, not decorative.
+        ablation = run(args.steps, os.path.join(args.out_dir, "ablation"),
+                       train_path, eval_path, augment=False, resume_leg=False)
+        ablation.pop("history")  # the main leg's curve is the committed one
+        record["ablation"] = ablation
+        record["ablation_gap"] = round(
+            record["final_eval_accuracy"] - ablation["final_eval_accuracy"], 4
+        )
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=960)  # ~15 epochs
-    ap.add_argument("--out-dir", default="/tmp/synthcifar")
-    args = ap.parse_args()
-    os.makedirs(args.out_dir, exist_ok=True)
-    record = run(args.steps, args.out_dir)
     with open(ARTIFACT + ".tmp", "w") as f:
         json.dump(record, f, indent=2)
         f.write("\n")
     os.replace(ARTIFACT + ".tmp", ARTIFACT)
     print("CONVERGENCE", record["final_eval_accuracy"],
-          "bar_met:", record["bar_met"])
+          "bar_met:", record["bar_met"],
+          "ablation_gap:", record.get("ablation_gap"))
     return 0 if record["bar_met"] else 1
 
 
