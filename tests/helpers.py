@@ -55,6 +55,7 @@ def run_on_tpu(code: str, timeout: int = 540) -> str:
     env.pop("JAX_NUM_CPU_DEVICES", None)
     if not _chip_alive(env):
         pytest.skip("TPU attached but wedged (backend init hangs)")
+    timeout = int(os.environ.get("DDL_TPU_SUBPROC_TIMEOUT", timeout))
     proc = subprocess.run(
         [sys.executable, "-c", code],
         env=env, capture_output=True, text=True, timeout=timeout,

@@ -3,6 +3,12 @@
 Each test compiles and runs a Pallas kernel (or a whole train step) on the
 attached TPU in a subprocess — the pytest process itself is pinned to the
 CPU simulator. Skipped automatically when no chip is attached.
+
+Every reference computation in these snippets is jitted: the chip is
+attached through a tunneled PJRT plugin, so an EAGER jnp expression is one
+network round-trip per op — the round-5 window measured the original
+eager-reference suite at >25 minutes (it burned two healthy windows at the
+1800 s budget), while a jitted reference is one compile + one transfer.
 """
 
 import pytest
@@ -20,15 +26,15 @@ assert jax.default_backend() == "tpu", jax.default_backend()
 qkv = [jax.random.normal(jax.random.PRNGKey(i), (2, 256, 4, 64), jnp.bfloat16)
        for i in range(3)]
 out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(*qkv)
-ref = attention_reference(*qkv, causal=True)
+ref = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))(*qkv)
 err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
 assert err < 0.05, err
 g = jax.jit(jax.grad(
     lambda q, k, v: jnp.mean(flash_attention(q, k, v, causal=True)
                              .astype(jnp.float32) ** 2), argnums=(0, 1, 2)))(*qkv)
-gr = jax.grad(
+gr = jax.jit(jax.grad(
     lambda q, k, v: jnp.mean(attention_reference(q, k, v, causal=True)
-                             .astype(jnp.float32) ** 2), argnums=(0, 1, 2))(*qkv)
+                             .astype(jnp.float32) ** 2), argnums=(0, 1, 2)))(*qkv)
 for a, b in zip(g, gr):
     assert float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                  - b.astype(jnp.float32)))) < 1e-4
@@ -51,7 +57,7 @@ mesh = single_device_mesh()
 qkv = [jax.random.normal(jax.random.PRNGKey(i), (2, 256, 4, 64), jnp.bfloat16)
        for i in range(3)]
 out = jax.jit(lambda q, k, v: ring_attention_pallas(q, k, v, mesh, causal=True))(*qkv)
-ref = attention_reference(*qkv, causal=True)
+ref = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))(*qkv)
 err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
 assert err < 0.05, err
 print("RING_PALLAS_TPU_OK")
@@ -75,8 +81,11 @@ def step(p, s):
     du, s = tx.update(g, s, p)
     return optax.apply_updates(p, du), s
 p, state = step(params, state)
-du, rstate = ref.update(g, rstate, params)
-rp = optax.apply_updates(params, du)
+@jax.jit
+def ref_step(p, s):
+    du, s = ref.update(g, s, p)
+    return optax.apply_updates(p, du), s
+rp, rstate = ref_step(params, rstate)
 err = max(float(jnp.max(jnp.abs(p[k] - rp[k]))) for k in params)
 assert err < 1e-5, err
 print("ADAMW_TPU_OK")
@@ -161,11 +170,20 @@ model = models.get_model("llama", size="tiny", vocab_size=97, max_len=64)
 prompt = np.random.default_rng(0).integers(0, 97, (2, 7), np.int32)
 params = model.init(jax.random.PRNGKey(1), jnp.asarray(prompt))["params"]
 got = np.asarray(generate(model, params, prompt, max_new_tokens=6))
-buf = jnp.asarray(prompt, jnp.int32)
-for _ in range(6):
+# Oracle with ONE compile: causal attention means logits at position p-1
+# ignore the zero-padding at positions >= p, so a fixed (2, 13) buffer
+# re-run per step is exact — the naive growing-buffer loop compiles 6
+# distinct shapes (minutes each through the tunneled remote-compile path).
+@jax.jit
+def next_logits(buf, p):
     logits = model.apply({"params": params}, buf)
-    buf = jnp.concatenate(
-        [buf, jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)[:, None]], 1)
+    return jnp.take_along_axis(
+        logits, (p - 1)[None, None, None].repeat(buf.shape[0], 0), axis=1
+    )[:, 0, :]
+buf = jnp.zeros((2, 7 + 6), jnp.int32).at[:, :7].set(jnp.asarray(prompt))
+for p in range(7, 13):
+    tok = jnp.argmax(next_logits(buf, jnp.int32(p)), -1).astype(jnp.int32)
+    buf = buf.at[:, p].set(tok)
 np.testing.assert_array_equal(got, np.asarray(buf))
 print("GENERATE_TPU_OK")
 """)
