@@ -151,6 +151,26 @@ def gpipe_bubble_fraction(num_microbatches: int, num_stages: int) -> float:
     return (num_stages - 1) / (num_microbatches + num_stages - 1)
 
 
+def _batch_sharded_call(local, mesh, param_specs, x_spec, stacked_params,
+                        x, extra):
+    """The one shard_map construction every pipeline engine shares.
+
+    ``local(params, x, extra)`` always takes three operands: ``extra=None``
+    is an empty pytree, so ``tree.map`` produces an empty spec subtree for
+    it and the mask-less and masked arities go through the SAME call —
+    review r5: the previous per-arity shard_map arms (four near-identical
+    blocks across gpipe/one_f_one_b) could drift apart silently."""
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            param_specs, x_spec, jax.tree.map(lambda _: x_spec, extra)
+        ),
+        out_specs=x_spec,
+    )
+    return fn(stacked_params, x, extra)
+
+
 def _pp_local_fwd(
     stage_fn, params, x, *, axis_name, num_microbatches, extra=None
 ):
@@ -330,23 +350,9 @@ def one_f_one_b(
         # transpose hands the full output cotangent to every stage.
         return jax.lax.psum(core(params, x, e), axis_name)
 
-    if extra is None:
-        fn = jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(param_specs, x_spec),
-            out_specs=x_spec,
-        )
-        return fn(stacked_params, x)
-    fn = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(
-            param_specs, x_spec, jax.tree.map(lambda _: x_spec, extra)
-        ),
-        out_specs=x_spec,
+    return _batch_sharded_call(
+        local, mesh, param_specs, x_spec, stacked_params, x, extra
     )
-    return fn(stacked_params, x, extra)
 
 
 def interleaved_1f1b(
@@ -619,29 +625,13 @@ def gpipe(
     if S == 1:
         # Degenerate ring: identical math to the sequential oracle.
         return sequential(stage_fn, stacked_params, x, extra=extra)
-    if extra is None:
-        fn = jax.shard_map(
-            lambda p, x: _gpipe_local(
-                stage_fn, p, x,
-                axis_name=axis_name, num_microbatches=num_microbatches,
-            ),
-            mesh=mesh,
-            in_specs=(param_specs, x_spec),
-            out_specs=x_spec,
-        )
-        return fn(stacked_params, x)
-    fn = jax.shard_map(
+    return _batch_sharded_call(
         lambda p, x, e: _gpipe_local(
             stage_fn, p, x,
             axis_name=axis_name, num_microbatches=num_microbatches, extra=e,
         ),
-        mesh=mesh,
-        in_specs=(
-            param_specs, x_spec, jax.tree.map(lambda _: x_spec, extra)
-        ),
-        out_specs=x_spec,
+        mesh, param_specs, x_spec, stacked_params, x, extra,
     )
-    return fn(stacked_params, x, extra)
 
 
 def sequential(stage_fn, stacked_params, x, extra=None):

@@ -28,3 +28,79 @@ COLLECTIVE_KINDS: tuple[str, ...] = (
 def collective_counts(hlo_text: str) -> dict[str, int]:
     """Count collective ops in compiled HLO text."""
     return {k: len(re.findall(k, hlo_text)) for k in COLLECTIVE_KINDS}
+
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# One op definition line: `%name = <type> <kind>(...)`, where <type> is a
+# shaped type or a tuple of them — long tuples carry `/*index=N*/` comments
+# inside the type, so the type match is a lazy wildcard anchored between
+# "= " and " <kind>(". The kind must be followed by "(" so the
+# `-start`/`-done` async halves and `-start` fusions don't double-count
+# (async pairs share one `-start(` definition; the `-done` line's operand
+# is the start's result, and its own type repeats the payload — match only
+# the `-start` / sync form).
+_OP_LINE = re.compile(
+    r"= (?P<type>\(?.*?\)?) "
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?P<start>-start)?\("
+)
+_SHAPE = re.compile(r"(?P<dt>[a-z]+[0-9]*)\[(?P<dims>[0-9,]*)\]")
+# `replica_groups={{0,1},{2,3}}` (explicit) or `replica_groups=[4,2]<=[8]`
+# (iota: 4 groups of 2).
+_GROUPS_EXPLICIT = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
+_GROUPS_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+
+
+def _type_bytes(type_str: str, last_only: bool = False) -> int:
+    """Byte size of a shaped type or tuple of them. ``last_only`` counts
+    just the final element — an async ``-start`` op's tuple type is
+    ``(operand..., result)``, and summing it would double-count the payload
+    (for all-gather-start the operand is the small pre-gather shard, so
+    halving would be wrong too; the result element is the payload)."""
+    sizes = []
+    for m in _SHAPE.finditer(type_str):
+        size = _DTYPE_BYTES.get(m.group("dt"))
+        if size is None:
+            continue  # token/opaque types carry no payload
+        n = 1
+        for d in m.group("dims").split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * size)
+    if last_only:
+        return sizes[-1] if sizes else 0
+    return sum(sizes)
+
+
+def collective_bytes(hlo_text: str, n_devices: int) -> dict[str, list]:
+    """Per-kind ``[(payload_bytes, group_size), ...]`` of every collective
+    in compiled HLO text. ``payload_bytes`` is the op's OUTPUT type size
+    (for all-gather that is the gathered size; callers apply the per-kind
+    ring-cost formula). ``group_size`` comes from ``replica_groups``
+    (explicit or iota form); ops without a parsable group default to
+    ``n_devices``. Feeds ``tools/project_scaling.py``'s projected-scaling
+    model (SURVEY §6 hard part #5: multi-chip claims must be labeled
+    projected, with their method inspectable)."""
+    out: dict[str, list] = {k: [] for k in COLLECTIVE_KINDS}
+    for line in hlo_text.splitlines():
+        m = _OP_LINE.search(line)
+        if not m:
+            continue
+        kind = m.group("kind")
+        payload = _type_bytes(
+            m.group("type"), last_only=bool(m.group("start"))
+        )
+        if not payload:
+            continue
+        g = _GROUPS_EXPLICIT.search(line)
+        if g:
+            group = len(g.group(1).split(","))
+        else:
+            g = _GROUPS_IOTA.search(line)
+            group = int(g.group(2)) if g else n_devices
+        out[kind].append((payload, group))
+    return out

@@ -278,17 +278,6 @@ def _run_killing_group(cmd: list, timeout: int):
         return None, out or ""
 
 
-def _parse_pytest_counts(out: str) -> dict:
-    """{'passed': N, 'skipped': N, 'failed': N} from a pytest -q tail."""
-    import re
-
-    counts = {"passed": 0, "skipped": 0, "failed": 0, "error": 0}
-    # pytest pluralizes: "1 error" but "2 errors" — normalize to one key.
-    for n, kind in re.findall(r"(\d+) (passed|skipped|failed|errors?)", out):
-        counts["error" if kind.startswith("error") else kind] = int(n)
-    return counts
-
-
 def _smoke_fingerprint() -> str:
     """Smoke-cache key: kernel code + the smoke-test file itself — an
     edited or new test must re-run even when the kernel code is unchanged."""
@@ -311,14 +300,23 @@ def _smoke_test_names() -> list:
             if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")]
 
 
-def _test_outcome(rc, counts: dict) -> str:
-    if rc is None:
-        return "timeout"  # chip likely re-wedged mid-test
-    if rc != 0 or counts["failed"] or counts["error"]:
-        return "failed"
-    if counts["passed"]:
-        return "passed"
-    return "skipped"  # no chip reachable, or the test skips in this env
+def _parse_verbose_results(out: str) -> dict:
+    """``{test_name: outcome}`` from ``pytest -v`` output. A name that
+    appears with no result token was IN PROGRESS when the run was killed
+    (pytest -v writes the test id before running it) — recorded as
+    "timeout". Names absent entirely never started."""
+    import re
+
+    results = {}
+    for name, res in re.findall(
+        r"::(test_\w+)(?:\s+(PASSED|FAILED|SKIPPED|ERROR))?", out
+    ):
+        if res:
+            results[name] = {"PASSED": "passed", "FAILED": "failed",
+                             "SKIPPED": "skipped", "ERROR": "failed"}[res]
+        else:
+            results.setdefault(name, "timeout")
+    return results
 
 
 def run_smoke_tier(deadline: float) -> None:
@@ -331,15 +329,15 @@ def run_smoke_tier(deadline: float) -> None:
     PER-TEST accumulation (round 5): the whole-suite-as-one-unit design
     burned two healthy windows — a mid-suite wedge discarded the proofs of
     every test that had already passed, and the next window started from
-    zero. Each test now runs as its own bounded pytest invocation and
-    SMOKE_TIER.json is rewritten after every one, so silicon proof
-    accumulates across windows. Per test, per kernel-code fingerprint:
-    "passed" is cached and never re-run; a reproducing "failed" is retried
-    up to 3 consecutive times (a broken kernel must not eat the top of
-    every window); "skipped"/"timeout" always re-run next window. A skip
-    whose reason is global (no chip / wedged, detected via the cached-probe
-    skip message) short-circuits the remaining tests — they would all skip
-    for the same reason, ~15 s of subprocess startup each.
+    zero. The still-pending tests now run as ONE bounded pytest invocation
+    (one interpreter startup + one chip probe per window, not per test —
+    review r5) whose per-test results are parsed from ``-v`` output, which
+    pytest emits incrementally: a mid-window kill still yields the
+    completed tests' outcomes, and the in-progress test records "timeout".
+    Per test, per kernel+test-code fingerprint: "passed" is cached and
+    never re-run; a reproducing "failed" is retried up to 3 consecutive
+    times (a broken kernel must not eat the top of every window);
+    "skipped"/"timeout" always re-run next window.
     """
     if os.environ.get("DDL_MEASURE_SKIP_SMOKE") == "1":
         return
@@ -356,7 +354,19 @@ def run_smoke_tier(deadline: float) -> None:
     names = _smoke_test_names()
     tests = {n: prior_tests.get(n, {}) for n in names}
 
-    def dump():
+    pending = []
+    for name in names:
+        prior_t = tests[name]
+        if prior_t.get("outcome") == "passed":
+            print(f"SMOKE {name}: cached pass", flush=True)
+        elif (prior_t.get("outcome") == "failed"
+              and int(prior_t.get("failed_attempts", 0)) >= 3):
+            print(f"SMOKE {name}: failed 3x for current kernel code — fix "
+                  "the kernel, don't burn windows", flush=True)
+        else:
+            pending.append(name)
+
+    def dump(rc=None, elapsed=None, tail=""):
         outcomes = [t.get("outcome") for t in tests.values()]
         if any(o == "failed" for o in outcomes):
             agg = "failed"
@@ -371,59 +381,48 @@ def run_smoke_tier(deadline: float) -> None:
         _atomic_dump({
             "outcome": agg,
             "tests": tests,
+            "returncode": rc,
+            "elapsed_s": elapsed,
+            "tail": tail,
             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "code_fingerprint": code,
             "shrunk": _SHRINKING,
         }, _SMOKE_PATH)
         return agg
 
-    per_test_cap = int(os.environ.get("DDL_SMOKE_TEST_TIMEOUT", "1000"))
-    for name in names:
-        prior_t = tests[name]
-        if prior_t.get("outcome") == "passed":
-            print(f"SMOKE {name}: cached pass", flush=True)
-            continue
-        failed_attempts = int(prior_t.get("failed_attempts", 0))
-        if prior_t.get("outcome") == "failed" and failed_attempts >= 3:
-            print(f"SMOKE {name}: failed 3x for current kernel code — fix "
-                  "the kernel, don't burn windows", flush=True)
-            continue
-        remaining = int(deadline - time.time())
-        if remaining < 60:
-            print("SMOKE budget exhausted — remaining tests next window",
-                  flush=True)
-            break
-        print(f"SMOKE running {name} ...", flush=True)
-        t0 = time.time()
-        rc, out = _run_killing_group(
-            [sys.executable, "-m", "pytest",
-             f"tests/test_tpu_smoke.py::{name}",
-             "-q", "--no-header", "-rs"],
-            timeout=min(per_test_cap, remaining),
-        )
-        counts = _parse_pytest_counts(out)
-        outcome = _test_outcome(rc, counts)
+    if not pending:
+        print("SMOKE", dump(), "(nothing pending)", flush=True)
+        return
+    remaining = int(deadline - time.time())
+    if remaining < 60:
+        print("SMOKE skip (window budget exhausted)", flush=True)
+        return
+    cap = int(os.environ.get("DDL_SMOKE_BUDGET", "1800"))
+    print(f"SMOKE running {len(pending)} pending tests ...", flush=True)
+    t0 = time.time()
+    rc, out = _run_killing_group(
+        [sys.executable, "-m", "pytest", "-v", "--no-header", "-rs"]
+        + [f"tests/test_tpu_smoke.py::{n}" for n in pending],
+        timeout=min(cap, remaining),
+    )
+    elapsed = round(time.time() - t0, 1)
+    results = _parse_verbose_results(out)
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    for name in pending:
+        outcome = results.get(name)
+        if outcome is None:
+            continue  # never started: keep the prior record for next window
+        prior_failed = int(tests[name].get("failed_attempts", 0))
         tests[name] = {
             "outcome": outcome,
-            "returncode": rc,
-            "tail": "\n".join(out.strip().splitlines()[-10:]),
             "failed_attempts":
-                failed_attempts + 1 if outcome == "failed" else 0,
-            "elapsed_s": round(time.time() - t0, 1),
-            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                prior_failed + 1 if outcome == "failed" else 0,
+            "utc": now,
         }
-        dump()  # after EVERY test: a mid-window wedge keeps earlier proofs
-        print(f"SMOKE {name}: {outcome} ({tests[name]['elapsed_s']}s)",
-              flush=True)
-        if outcome == "skipped" and (
-            "no TPU attached" in out or "wedged" in out
-        ):
-            # Global condition, not a per-test skip: stop probing.
-            print("SMOKE chip unreachable — skipping remaining tests",
-                  flush=True)
-            break
-    agg = dump()
-    print("SMOKE", agg, flush=True)
+        print(f"SMOKE {name}: {outcome}", flush=True)
+    agg = dump(rc=rc, elapsed=elapsed,
+               tail="\n".join(out.strip().splitlines()[-12:]))
+    print("SMOKE", agg, f"({elapsed}s)", flush=True)
 
 
 def main() -> int:
