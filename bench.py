@@ -84,6 +84,14 @@ def _probe_backend() -> tuple[str, dict]:
 def _run_child(mode: str) -> dict | None:
     """Run the measurement child; return its parsed record or None."""
     env = dict(os.environ) if mode == "tpu" else _scrubbed_cpu_env()
+    # Share the harvest tools' persistent compile cache: if the watcher
+    # already compiled this config in an earlier healthy window, the
+    # round-end bench child skips straight to measuring.
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"),
+    )
     timeout = TPU_BENCH_TIMEOUT_S if mode == "tpu" else CPU_BENCH_TIMEOUT_S
     try:
         out = subprocess.run(

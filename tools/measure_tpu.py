@@ -44,6 +44,16 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+# Persistent compilation cache (round 5): compile time through the
+# tunneled remote-compile path dominates each config's window cost, and
+# the chip's healthy windows are ~30 min — a config compiled in one window
+# must not pay compile again in the next. Inherited by every child
+# (smoke-tier pytest, decode bench). Harmless if the backend declines to
+# cache (plain cache miss).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+)
+
 # (config, overrides, warmup, timed steps) — kernel-exercising configs first.
 RUNS = [
     # flash attention + fused AdamW + chunked head + ZeRO-1

@@ -43,6 +43,11 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+# Same persistent compile cache as measure_tpu.py (cells inherit the env).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+)
+
 _OUT = os.environ.get("DDL_MFU_OUT", os.path.join(_REPO, "MFU_ATTACK.json"))
 _SHRINK = os.environ.get("DDL_MFU_SHRINK") == "1"
 # Per-cell subprocess ceiling; the shared DDL_MFU_BUDGET deadline caps it

@@ -220,8 +220,9 @@ def main() -> int:
             "projections": projections,
             "compile_seconds": round(time.time() - t0, 1),
         })
-        print(f"{name}: sync={sync} t_compute="
-              f"{rows[-1]['t_compute_ms']}ms", flush=True)
+        tc = rows[-1]["t_compute_ms"]
+        print(f"{name}: sync={sync} "
+              f"t_compute={f'{tc}ms' if tc else 'unmeasured'}", flush=True)
 
     artifact = {
         "projected_not_measured": True,
