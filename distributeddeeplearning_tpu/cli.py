@@ -22,13 +22,16 @@ from .train import Trainer, fit, get_task, make_optimizer, parse_fault_injection
 from .utils.pytree import tree_size
 
 
-def build_all(cfg: Config, split: str = "train"):
+def build_all(cfg: Config, split: str = "train", devices=None):
     """Construct (mesh, model, trainer, dataset) from a config.
 
     ``split='eval'`` builds the dataset from the eval-split kwargs instead —
     used by ``cmd_eval`` so a standalone eval doesn't also load the training
-    data (for record-file kinds that would hold the file in memory twice)."""
-    mesh = build_mesh(cfg.mesh)
+    data (for record-file kinds that would hold the file in memory twice).
+    ``devices`` overrides the mesh's device set — tools/aot_tpu_check.py
+    passes ABSTRACT topology devices to AOT-compile the exact train step a
+    real run of this config would execute."""
+    mesh = build_mesh(cfg.mesh, devices=devices)
     model = models.get_model(cfg.model.name, **cfg.model.kwargs)
     # Mesh-aware models (ring/Ulysses attention, pipelined stacks) need the
     # live mesh; a config that asked for those features but got no mesh would

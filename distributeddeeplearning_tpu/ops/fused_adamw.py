@@ -1,18 +1,25 @@
 """Fused AdamW update — Pallas kernel + optax-compatible wrapper.
 
 TPU-native equivalent of the reference's hand-written "CUDA optimizer
-step" (``BASELINE.json:5``): ONE VPU pass over the whole parameter tree —
-all kernel-sized leaves are flattened into a single padded ``(rows, 128)``
-buffer per (param dtype, decay group), so the step compiles one kernel
-variant and pays one launch per group — at most two per dtype with
-weight decay on (decayed matrices vs undecayed norm scales) — instead of
-one per leaf (dozens of remote Mosaic compiles for GPT-2 otherwise). The trade: the per-step ``concatenate``/slice costs one
-extra HBM round trip of the p/g/m/v buffers around the kernel; storing the
-moments flat (so no per-step concat is needed) is the known next step. XLA
-already fuses the optax elementwise chain well, so this kernel is an
-*optional* drop-in (``make_optimizer("adamw_fused", ...)``) — its value is
-pinning the fusion and the fp32 moment arithmetic explicitly, and serving
-as the template for further fused update rules.
+step" (``BASELINE.json:5``): one VPU kernel over the whole parameter
+tree. Kernel-sized leaves are grouped by (param dtype, decay group) —
+at most two groups per dtype with weight decay on (decayed matrices vs
+undecayed norm scales) — and each group is processed in fixed-size
+BUCKETS of ``_BUCKET_ROWS`` x 128 elements: all full buckets share one
+padded ``(rows, 128)`` shape, so the step still compiles ~one kernel
+variant per group (plus at most one tail shape) instead of one per leaf
+(dozens of remote Mosaic compiles for GPT-2 otherwise), while peak
+scratch is ~7 bucket-sized buffers (~450 MiB) rather than ~7 GROUP-sized
+ones — the whole-group concat this replaced held an 11.2 GiB temp
+allocation for ViT-L's 325M-param decay group (round-5 buffer-assignment
+dump; see _BUCKET_ROWS comment). The trade: the per-step
+``concatenate``/slice still costs one extra HBM round trip of the
+p/g/m/v buffers around the kernel; storing the moments flat (so no
+per-step concat is needed) is the known next step. XLA already fuses the
+optax elementwise chain well, so this kernel is an *optional* drop-in
+(``make_optimizer("adamw_fused", ...)``) — its value is pinning the
+fusion and the fp32 moment arithmetic explicitly, and serving as the
+template for further fused update rules.
 
 Leaves smaller than one fp32 tile (8x128) stay on the plain-jnp path — a
 kernel's padding overhead per bias vector would cost more than it saves.
@@ -33,6 +40,16 @@ _LANES = 128
 _SUBLANES = 8
 _MIN_KERNEL_SIZE = _LANES * _SUBLANES  # below this, plain jnp wins
 _MAX_BLOCK_ROWS = 1024  # 1024x128 fp32 = 512 KiB per buffer in VMEM
+# Per-bucket cap on the flattened group buffers (rows of 128 lanes;
+# 131072 rows = 16.8M elements = 64 MiB fp32). Concatenating a whole
+# group at once put ~7 group-sized copies (p/g/m/v in, delta/m/v out) on
+# the heap at the kernel — for ViT-L's 325M-param decayed group that was
+# an 11.2 GiB temp allocation (XLA buffer-assignment dump, round 5),
+# pushing the train step past the v5e's 16 GB at the bench batch.
+# Bucketing bounds the scratch at ~7 bucket-sized buffers while keeping
+# the one-kernel-variant-per-group compile property (all full buckets
+# share one shape; a group adds at most one tail shape).
+_BUCKET_ROWS = 131072
 
 
 def _default_interpret() -> bool:
@@ -97,6 +114,16 @@ def _fused_leaf(p, g, m, v, lr, c1, c2, *, b1, b2, eps, wd, interpret):
             jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
             jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
         ],
+        # The flat p/m/v buffers are step-local copies (concatenated from
+        # the leaves) that die at this call — alias them into the
+        # same-shaped outputs so the kernel updates in place instead of
+        # holding 3 extra param-sized buffers live. Found by
+        # AOT_TPU_CHECK's memory analysis (round 5): ViT-L's update held
+        # ~11x params of scratch, 17.9 GB peak at the bench batch — over
+        # the v5e's 16 GB — of which this aliasing removes ~3x params.
+        # (Indices are positions in the full input list, scalars included:
+        # p=3 -> delta, m=5 -> new_m, v=6 -> new_v; dtypes/shapes match.)
+        input_output_aliases={3: 0, 5: 1, 6: 2},
         interpret=interpret,
     )(
         jnp.asarray(lr, jnp.float32).reshape(1, 1),
@@ -227,23 +254,49 @@ def fused_adamw(
             else:
                 groups.setdefault((jnp.dtype(p.dtype), wd_i), []).append(i)
 
+        bucket_elems = _BUCKET_ROWS * _LANES
         for (dtype, wd_i), idxs in groups.items():
-            flat = lambda leaves: jnp.concatenate(  # noqa: E731
-                [leaves[i].reshape(-1) for i in idxs]
-            )
-            d_f, nm_f, nv_f = _fused_leaf(
-                flat(p_leaves), flat(g_leaves), flat(m_leaves), flat(v_leaves),
-                lr, c1, c2,
-                b1=b1, b2=b2, eps=eps, wd=wd_i, interpret=ip,
-            )
+            # Piece table at trace time: which (leaf, leaf-range) lands in
+            # which bucket. Leaves larger than a bucket span several.
+            by_bucket: list = []  # bucket -> [(leaf idx, leaf off, len)]
             off = 0
             for i in idxs:
-                sz = p_leaves[i].size
+                sz, lo = p_leaves[i].size, 0
+                while lo < sz:
+                    b, bo = divmod(off, bucket_elems)
+                    if b == len(by_bucket):
+                        by_bucket.append([])
+                    ln = min(sz - lo, bucket_elems - bo)
+                    by_bucket[b].append((i, lo, ln))
+                    lo += ln
+                    off += ln
+            out_pieces: dict = {i: [] for i in idxs}
+            for bp in by_bucket:
+                flat = lambda leaves: jnp.concatenate(  # noqa: E731
+                    [leaves[i].reshape(-1)[lo : lo + ln]
+                     for i, lo, ln in bp]
+                )
+                d_f, nm_f, nv_f = _fused_leaf(
+                    flat(p_leaves), flat(g_leaves),
+                    flat(m_leaves), flat(v_leaves),
+                    lr, c1, c2,
+                    b1=b1, b2=b2, eps=eps, wd=wd_i, interpret=ip,
+                )
+                o = 0
+                for i, lo, ln in bp:
+                    out_pieces[i].append(
+                        (d_f[o : o + ln], nm_f[o : o + ln], nv_f[o : o + ln])
+                    )
+                    o += ln
+            for i in idxs:
+                ds_, ms_, vs_ = zip(*out_pieces[i])
+                cat = lambda xs: (  # noqa: E731
+                    xs[0] if len(xs) == 1 else jnp.concatenate(xs)
+                )
                 shape = p_leaves[i].shape
-                deltas[i] = d_f[off : off + sz].reshape(shape)
-                nms[i] = nm_f[off : off + sz].reshape(shape)
-                nvs[i] = nv_f[off : off + sz].reshape(shape)
-                off += sz
+                deltas[i] = cat(ds_).reshape(shape)
+                nms[i] = cat(ms_).reshape(shape)
+                nvs[i] = cat(vs_).reshape(shape)
 
         return treedef.unflatten(deltas), FusedAdamWState(
             count=count,

@@ -201,3 +201,50 @@ class TestShardedTrainerParity:
             )
         )
         assert max(diffs) > 0.0
+
+
+def test_bucketed_groups_match_optax(monkeypatch):
+    """Leaves larger than a bucket (round 5: group processing is bucketed
+    so peak scratch is ~7 bucket-sized buffers, not ~7 group-sized ones —
+    ViT-L's whole-group concat was an 11.2 GiB temp allocation) must split
+    across buckets and reassemble exactly. A shrunken bucket forces: a
+    leaf spanning multiple buckets, a bucket boundary INSIDE a leaf, and
+    several whole leaves packed into one bucket."""
+    import importlib
+
+    fa = importlib.import_module(
+        "distributeddeeplearning_tpu.ops.fused_adamw"
+    )
+    monkeypatch.setattr(fa, "_BUCKET_ROWS", 16)  # 16*128 = 2048 elements
+    params = {
+        "big": jax.random.normal(jax.random.PRNGKey(0), (40, 130)),  # 2.5 buckets
+        "mid": jax.random.normal(jax.random.PRNGKey(1), (17, 129)),
+        "tiny": jax.random.normal(jax.random.PRNGKey(2), (9,)),  # jnp path
+    }
+    from distributeddeeplearning_tpu.ops.fused_adamw import decay_leaf
+
+    ref_tx = optax.adamw(
+        3e-3, b1=0.9, b2=0.95, weight_decay=0.1,
+        mask=lambda ps: jax.tree.map(decay_leaf, ps),
+    )
+    fus_tx = fa.fused_adamw(3e-3, b1=0.9, b2=0.95, weight_decay=0.1)
+    ref_state, fus_state = ref_tx.init(params), fus_tx.init(params)
+    p_ref = p_fus = params
+    for step in range(3):
+        grads = jax.tree.map(
+            lambda p: jax.random.normal(
+                jax.random.fold_in(jax.random.PRNGKey(7), step), p.shape
+            ).astype(p.dtype),
+            p_ref,
+        )
+        du_ref, ref_state = ref_tx.update(grads, ref_state, p_ref)
+        du_fus, fus_state = fus_tx.update(grads, fus_state, p_fus)
+        p_ref = optax.apply_updates(p_ref, du_ref)
+        p_fus = optax.apply_updates(p_fus, du_fus)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=1e-4, rtol=1e-4,
+        ),
+        p_fus, p_ref,
+    )
