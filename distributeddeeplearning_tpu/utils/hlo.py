@@ -55,12 +55,15 @@ _GROUPS_EXPLICIT = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
 _GROUPS_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
 
 
-def _type_bytes(type_str: str, last_only: bool = False) -> int:
-    """Byte size of a shaped type or tuple of them. ``last_only`` counts
-    just the final element — an async ``-start`` op's tuple type is
-    ``(operand..., result)``, and summing it would double-count the payload
-    (for all-gather-start the operand is the small pre-gather shard, so
-    halving would be wrong too; the result element is the payload)."""
+def _type_bytes(type_str: str, start_op: bool = False) -> int:
+    """Byte size of a shaped type or tuple of them. ``start_op`` counts
+    just the LARGEST element: an async ``-start`` op's tuple type is
+    ``(operand, result, scratch/flag entries...)`` — on TPU,
+    collective-permute-start appends ``u32[]`` flags, so "last element"
+    would read 4 bytes — and summing would double-count the payload. The
+    largest element is the payload under this module's conventions for
+    every kind (all-gather: the gathered result; reduce-scatter: the
+    full input; all-reduce/permute: operand == result)."""
     sizes = []
     for m in _SHAPE.finditer(type_str):
         size = _DTYPE_BYTES.get(m.group("dt"))
@@ -71,8 +74,8 @@ def _type_bytes(type_str: str, last_only: bool = False) -> int:
             if d:
                 n *= int(d)
         sizes.append(n * size)
-    if last_only:
-        return sizes[-1] if sizes else 0
+    if start_op:
+        return max(sizes) if sizes else 0
     return sum(sizes)
 
 
@@ -92,7 +95,7 @@ def collective_bytes(hlo_text: str, n_devices: int) -> dict[str, list]:
             continue
         kind = m.group("kind")
         payload = _type_bytes(
-            m.group("type"), last_only=bool(m.group("start"))
+            m.group("type"), start_op=bool(m.group("start"))
         )
         if not payload:
             continue

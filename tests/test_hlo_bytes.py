@@ -15,17 +15,24 @@ def test_sync_tuple_allreduce_sums_all_elements():
     assert got["all-reduce"] == [(4 * (64 + 3 * 3 * 16 * 16 + 256), 8)]
 
 
-def test_async_start_counts_result_only_and_done_not_at_all():
-    # The -start tuple is (operand, result): summing would double-count;
-    # for all-gather the operand is the small pre-gather shard, so the
-    # result (last) element is the payload.
+def test_async_start_counts_payload_only_and_done_not_at_all():
+    # The -start tuple is (operand, result, scratch/flags...): summing
+    # would double-count, and "last element" reads a 4-byte u32 flag on
+    # TPU permute-starts (observed in the gpt2_owt lowering, where the
+    # grad reduce-scatter decomposes into 224 permutes). The LARGEST
+    # element is the payload for every kind.
     txt = "\n".join([
         "%ags = (bf16[128]{0}, bf16[1024]{0}) all-gather-start(%x), "
         "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}",
         "%agd = bf16[1024]{0} all-gather-done(%ags)",
+        "%cps = (bf16[192,12,64]{0,2,1}, bf16[192,12,64]{0,2,1}, "
+        "u32[]{:S(2)}, u32[]{:S(2)}) collective-permute-start(%b), "
+        "channel_id=6, source_target_pairs={{0,1},{1,0}}",
+        "%cpd = bf16[192,12,64]{0,2,1} collective-permute-done(%cps)",
     ])
     got = collective_bytes(txt, 8)
     assert got["all-gather"] == [(2 * 1024, 8)]
+    assert got["collective-permute"] == [(2 * 192 * 12 * 64, 8)]
 
 
 def test_explicit_and_iota_groups_and_default():

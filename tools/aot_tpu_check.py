@@ -54,6 +54,11 @@ if os.environ.get("PALLAS_AXON_POOL_IPS"):
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+# Deviceless TPU compiles are slow on this 1-core host; share the harvest
+# tools' persistent compile cache so row refreshes are incremental.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+)
 
 _OUT = os.environ.get(
     "DDL_AOT_OUT", os.path.join(_REPO, "AOT_TPU_CHECK.json")
@@ -178,7 +183,8 @@ def _compile_row(cfg_name: str, overrides: list, devices) -> dict:
         trainer.abstract_state_with_shardings(), abs_batch
     ).compile()
     text = compiled.as_text()
-    cb = collective_bytes(text, len(devices))
+    n_dev = len(devices)
+    cb = collective_bytes(text, n_dev)
     ma = compiled.memory_analysis()
     mem = {
         k: int(getattr(ma, k))
@@ -200,6 +206,19 @@ def _compile_row(cfg_name: str, overrides: list, devices) -> dict:
     return {
         "collective_payload_bytes_by_kind": {
             k: sum(b for b, _ in v) for k, v in cb.items() if v
+        },
+        # FULL-mesh-group traffic (the dp/fsdp axes on these compiles) vs
+        # tp/ep/cp subgroup ops — the split tools/project_scaling.py's
+        # comm model consumes, from the AUTHORITATIVE TPU lowering (the
+        # CPU SPMD emitter lowers reduce-scatter as all-reduce and keeps
+        # fp32 where the TPU pipeline syncs bf16). Caveat: permutes carry
+        # no replica_groups and default to full-mesh, so rows whose mesh
+        # has pp/cp axes count stage/ring permutes here too — fine for
+        # the dp-only projection scenarios, misleading for pp rows.
+        "n_devices": n_dev,
+        "sync_payload_bytes_by_kind": {
+            k: sum(b for b, g in v if g >= n_dev)
+            for k, v in cb.items() if v
         },
         "memory": mem,
         "hlo_bytes": len(text),

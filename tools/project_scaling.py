@@ -119,6 +119,47 @@ def _comm_seconds(sync: dict, ici: int, n_slices: int) -> float:
     return t
 
 
+def _tpu_lowered_sync(name: str):
+    """TPU-lowered dp-sync bytes for this config from AOT_TPU_CHECK.json
+    (full-size rows only), or None. Preferred over this tool's CPU-sim
+    compile when present: the CPU SPMD emitter lowers reduce-scatter as a
+    full all-reduce and keeps fp32 where the TPU pipeline syncs bf16, so
+    the CPU-derived comm bytes overstate ZeRO-1 traffic ~2x (both counts
+    are recorded; the artifact names which one each projection used)."""
+    path = os.path.join(_REPO, "AOT_TPU_CHECK.json")
+    if _SHRINK or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            rows = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+    row = rows.get(name)
+    if not (isinstance(row, dict) and row.get("ok")
+            and not row.get("shrunk")
+            and isinstance(row.get("sync_payload_bytes_by_kind"), dict)):
+        return None
+    raw = row["sync_payload_bytes_by_kind"]
+    n0 = int(row.get("n_devices", 4))
+    # Translate the lowered ops into the ring model's n-INVARIANT abstract
+    # payloads (review r5: feeding geometry-baked byte counts into (n-1)/n
+    # factors double-applies the topology):
+    #   - all-gather/all-reduce payloads are the full tensor sizes —
+    #     already n-invariant;
+    #   - the TPU pipeline decomposes the grad reduce-scatter into
+    #     permutes whose TOTAL is B*(n0-1)/n0 at the compile geometry n0;
+    #     recover B and model it as a reduce-scatter;
+    #   - all-to-all here is ACTIVATION traffic (scales with batch, e.g.
+    #     the chunked-head exchange), not parameter sync: excluded.
+    sync = {k: raw[k] for k in ("all-gather", "all-reduce",
+                                "reduce-scatter") if raw.get(k)}
+    if raw.get("collective-permute"):
+        sync["reduce-scatter"] = sync.get("reduce-scatter", 0) + int(
+            raw["collective-permute"] * n0 / (n0 - 1)
+        )
+    return sync or None
+
+
 def _measured_step_seconds(name: str, key: str):
     """(t_compute seconds, provenance) from the silicon records, or
     (None, reason)."""
@@ -180,9 +221,11 @@ def main() -> int:
         other = {k: sum(b for b, g in v if g < n_dev // 2)
                  for k, v in cb.items()}
         t_compute, provenance = _measured_step_seconds(name, key)
+        tpu_sync = _tpu_lowered_sync(name)
+        model_sync = tpu_sync if tpu_sync is not None else sync
         projections = []
         for label, n, ici, n_slices in TOPOLOGIES:
-            t_comm = _comm_seconds(sync, ici, n_slices)
+            t_comm = _comm_seconds(model_sync, ici, n_slices)
             proj = {
                 "topology": label,
                 "n_chips": n,
@@ -212,6 +255,11 @@ def main() -> int:
             "sync_payload_bytes_by_kind": {
                 k: v for k, v in sync.items() if v
             },
+            "sync_payload_bytes_by_kind_tpu_lowered": tpu_sync,
+            "comm_model_source": (
+                "AOT_TPU_CHECK.json (TPU lowering)" if tpu_sync is not None
+                else "CPU-sim compile (conservative: RS lowered as AR)"
+            ),
             "non_sync_payload_bytes_by_kind": {
                 k: v for k, v in other.items() if v
             },
