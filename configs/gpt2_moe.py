@@ -32,6 +32,15 @@ def get_config() -> Config:
                 "num_selected": 2,
                 "capacity_factor": 1.25,
                 "moe_every": 2,
+                # Same memory-efficient hot path as gpt2_owt (round 5:
+                # the AOT memory artifact showed this config materializing
+                # full fp32 [B,S,V] logits — 1.65 GB — and per-layer
+                # [B,H,S,S] score matrices; flash + the chunked head +
+                # bf16 were already supported by the MoE model, just not
+                # enabled here).
+                "attn_impl": "flash",
+                "chunked_head": True,
+                "dtype": "bfloat16",
             },
         ),
         data=DataConfig(
