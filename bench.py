@@ -163,7 +163,8 @@ def _child_main(mode: str) -> int:
         "device_count": record["device_count"],
         "steps_per_sec": record["steps_per_sec"],
     }
-    for key in ("model_tflops_per_step", "achieved_tflops_per_sec", "mfu"):
+    for key in ("model_tflops_per_step", "achieved_tflops_per_sec", "mfu",
+                "grad_comm", "grad_sync_bytes_per_step"):
         if key in record:
             out[key] = record[key]
     print(json.dumps(out))

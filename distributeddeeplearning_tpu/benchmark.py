@@ -158,6 +158,20 @@ def run_benchmark(
         "platform": jax.default_backend(),
         "loss": float(metrics["loss"]),
     }
+    # Gradient-sync wire bytes per member per step under the configured
+    # grad_comm mode (analytic ring model, parallel/fsdp.grad_sync_bytes) —
+    # the byte side of the compressed-collectives win (comms_quant.py): an
+    # int8 row reads ~4x below the same config at fp32. 0 when dp == 1
+    # (nothing to sync over).
+    from .parallel.fsdp import grad_sync_bytes
+
+    record["grad_comm"] = cfg.train.grad_comm
+    record["grad_sync_bytes_per_step"] = grad_sync_bytes(
+        state.params,
+        mode=cfg.train.grad_comm,
+        block_size=cfg.train.grad_comm_block,
+        n_members=mesh.shape["dp"],
+    )
     # HBM telemetry (VERDICT r4 Weak #5): peak bytes decide e.g. whether the
     # batch-512 MFU cell even fits. Key always present — a null must read as
     # "plugin doesn't report", never be confused with "not recorded".

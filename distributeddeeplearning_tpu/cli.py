@@ -79,6 +79,8 @@ def build_all(cfg: Config, split: str = "train", devices=None):
         mesh,
         grad_accum=cfg.train.grad_accum,
         zero1=cfg.train.zero1,
+        grad_comm=cfg.train.grad_comm,
+        grad_comm_block=cfg.train.grad_comm_block,
         **trainer_kw,
     )
     data_kwargs = (

@@ -141,6 +141,12 @@ class TrainConfig:
     # seq dim over tp between blocks (parallel/tp.tp_rules(sequence_parallel
     # =True) threaded through build_all). Needs mesh.tp > 1 to have effect.
     sequence_parallel: bool = False
+    # Gradient-sync compression (comms_quant.py): "fp32" = uncompressed
+    # auto-sharded all-reduce; "bf16"/"int8" = explicit ring all-reduce on a
+    # compressed payload (int8 adds block scales + error feedback). Lossy
+    # modes are pure-DP only in v1 (the Trainer fences compositions).
+    grad_comm: str = "fp32"
+    grad_comm_block: int = 256  # int8 quantization block size (elements)
     log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
     # Debug/fault tooling (SURVEY §5): the XLA-world equivalents of the

@@ -64,6 +64,29 @@ def _spec_uses(spec, axis: str) -> bool:
     return False
 
 
+def grad_sync_bytes(
+    tree, *, mode: str = "fp32", block_size: int = 256, n_members: int = 2
+) -> int:
+    """Per-member wire bytes of one data-parallel gradient sync of ``tree``.
+
+    The analytic counterpart of the HLO measurement (``utils/hlo.py``): a
+    ring all-reduce over ``n`` members ships ``2*(n-1)`` hops of ``P/n``
+    elements each, at the payload width of the ``grad_comm`` mode
+    (comms_quant: int8 values + one f32 scale per ``block_size`` — ~4x under
+    fp32). ``bench.py`` / ``benchmark.py`` report this next to measured
+    step time so the byte win per mode is visible without an HLO dump.
+    """
+    from ..comms_quant import compression_ratio
+
+    n_elems = sum(
+        math.prod(getattr(leaf, "shape", ()) or (1,))
+        for leaf in jax.tree.leaves(tree)
+    )
+    per_hop = -(-n_elems // n_members)  # ceil: ring chunks are padded equal
+    bytes_per_elem = 4.0 * compression_ratio(mode, block_size)
+    return int(2 * (n_members - 1) * per_hop * bytes_per_elem)
+
+
 def per_device_bytes(tree) -> int:
     """Actual per-device HBM footprint of a sharded pytree (sum of addressable
     shard bytes on device 0's shards)."""

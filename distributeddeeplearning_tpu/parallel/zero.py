@@ -71,3 +71,20 @@ def shard_opt_state_shardings(
         return sharding
 
     return jax.tree.map(rewrite, opt_shardings, abs_opt_state)
+
+
+def residual_shardings(abs_residual, mesh: Mesh, axis: str = "dp"):
+    """NamedShardings for the error-feedback residual tree
+    (``train.TrainState.grad_residual``, grad_comm in {int8, bf16}).
+
+    Each leaf carries a leading device dimension of size ``mesh.shape[axis]``
+    holding every member's OWN local compression error — residuals are
+    per-device state, never synced, so the only correct placement is sharded
+    over the sync axis on that dimension (replication would silently make
+    all members share member 0's residual after a checkpoint round-trip).
+    Composes with zero1: the residual is separate from the optimizer state
+    and this placement adds no bytes beyond 1x params per member.
+    """
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, P(axis)), abs_residual
+    )

@@ -37,6 +37,7 @@ from .sharding import (
     logical_to_mesh_sharding,
     validate_tree_shardings,
 )
+from .utils import compat
 from .utils.rng import fold_in_step
 
 
@@ -46,6 +47,13 @@ class TrainState:
 
     ``model_state`` holds non-trained collections (e.g. BatchNorm running
     stats); empty dict for pure-functional models.
+
+    ``grad_residual`` is the error-feedback residual of the compressed
+    gradient sync (``grad_comm`` in {int8, bf16}; see ``comms_quant.py``):
+    per-parameter trees with a leading per-member dimension sharded over the
+    ``dp`` axis (``parallel/zero.residual_shardings``). ``None`` — and absent
+    from the pytree, so fp32 checkpoints are unchanged — when ``grad_comm``
+    is fp32.
     """
 
     step: jax.Array
@@ -53,6 +61,7 @@ class TrainState:
     opt_state: Any
     model_state: Any
     rng: jax.Array
+    grad_residual: Any = None
 
 
 # ---------------------------------------------------------------------------
@@ -302,11 +311,54 @@ class Trainer:
         zero1: bool = False,
         donate: bool = True,
         allow_idle_axes: bool = False,
+        grad_comm: str = "fp32",
+        grad_comm_block: int = 256,
     ):
         self.model = model
         self.tx = tx
         self.task = task
         self.mesh = mesh
+        # Compressed gradient sync (comms_quant.py) fences: the lossy modes
+        # replace the partitioner-emitted all-reduce with an explicit
+        # shard_map ring over 'dp', which is only correct when 'dp' is the
+        # ONLY model-parallel-free sync axis in play — under fsdp/tp/pp/cp/ep
+        # the partitioner's gradient collectives are interleaved with
+        # parameter gathers this path does not reproduce, and under
+        # grad_accum the residual would need per-microbatch threading.
+        # zero1 composes: it is purely optimizer-state placement downstream
+        # of the (replicated) synced grads.
+        from .comms_quant import GRAD_COMM_MODES
+
+        if grad_comm not in GRAD_COMM_MODES:
+            raise ValueError(
+                f"grad_comm={grad_comm!r} not in {GRAD_COMM_MODES}"
+            )
+        if grad_comm != "fp32":
+            if hasattr(model, "num_stages"):
+                raise NotImplementedError(
+                    f"grad_comm={grad_comm!r} x pipelined model "
+                    f"{type(model).__name__} is unsupported in v1: the "
+                    "pipeline engine computes grads inside its schedule — "
+                    "use grad_comm='fp32'"
+                )
+            busy = {
+                a: mesh.shape[a]
+                for a in ("fsdp", "tp", "pp", "cp", "ep")
+                if mesh.shape[a] > 1
+            }
+            if busy:
+                raise NotImplementedError(
+                    f"grad_comm={grad_comm!r} is pure-DP in v1 but the mesh "
+                    f"has {busy}: quantized sync composes with dp/zero1 only"
+                )
+            if grad_accum > 1:
+                raise NotImplementedError(
+                    f"grad_comm={grad_comm!r} x grad_accum={grad_accum} is "
+                    "unsupported in v1: accumulate-then-compress needs the "
+                    "residual threaded through the microbatch scan"
+                )
+        self.grad_comm = grad_comm
+        self.grad_comm_block = grad_comm_block
         # Composition fences (VERDICT r4 Missing #4): every {dp,fsdp,tp,pp,
         # cp,ep} pair either composes (tested) or fails HERE by name. The
         # unsupported-composition fence (pipeline x ep/cp) is unconditional;
@@ -378,12 +430,23 @@ class Trainer:
         variables.pop("losses", None)
         variables.pop("metrics", None)
         opt_state = self.tx.init(params)
+        grad_residual = None
+        if self.grad_comm != "fp32":
+            # EF residual: one f32 copy of the params PER dp member (leading
+            # device dim, sharded over 'dp' — see setup()). Unboxed so the
+            # logical-rules pass leaves it alone.
+            dp = self.mesh.shape["dp"]
+            grad_residual = jax.tree.map(
+                lambda p: jnp.zeros((dp, *jnp.shape(p)), jnp.float32),
+                nn.meta.unbox(params),
+            )
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=opt_state,
             model_state=dict(variables),
             rng=s_rng,
+            grad_residual=grad_residual,
         )
 
     def setup(self, example_batch) -> None:
@@ -416,6 +479,14 @@ class Trainer:
                     self.state_shardings.opt_state,
                     self.abstract_state.opt_state,
                     self.mesh,
+                )
+            )
+        if self.grad_comm != "fp32":
+            from .parallel.zero import residual_shardings
+
+            self.state_shardings = self.state_shardings.replace(
+                grad_residual=residual_shardings(
+                    self.abstract_state.grad_residual, self.mesh
                 )
             )
 
@@ -535,7 +606,7 @@ class Trainer:
         # check_vma=False: pallas_call inside shard_map (jax 0.9.0 vma-typing
         # limitation, same as the ring/flash kernels); the body has no
         # collectives — every shard's update is independent.
-        return jax.shard_map(
+        return compat.shard_map(
             self.tx.update,
             mesh=self.mesh,
             in_specs=(mu_specs, state_specs, mu_specs),
@@ -626,6 +697,101 @@ class Trainer:
             self.mesh,
         )
 
+    def _make_quantized_dp_train_step(self):
+        """grad_comm in {int8, bf16}: explicit compressed gradient sync.
+
+        The auto-sharded path never materializes the gradient all-reduce as
+        code (the partitioner emits it from the global-batch-mean loss), so
+        there is nothing to intercept — instead the WHOLE loss-and-grad
+        computation runs under ``shard_map`` over the mesh: each member
+        differentiates the loss of its LOCAL batch shard (a mean over
+        ``B/n`` examples), then the compressed ring
+        (``comms_quant.quantized_tree_all_reduce``) sums the local grads and
+        ``/n`` recovers exactly the global-batch-mean gradient the fp32 path
+        computes. The optimizer update stays OUTSIDE the shard_map, on the
+        replicated synced grads, so the fused-AdamW / ZeRO-1 dispatch in
+        :meth:`_tx_update` is unchanged.
+
+        Plain ``jax.jit`` (not MeshedJit): the body is manual-mode, where
+        ``sharding.constrain`` must stay a no-op — pure DP (fenced in
+        ``__init__``) has no activation constraints to lose.
+        """
+        from . import comms_quant
+        from jax.sharding import PartitionSpec as P
+
+        mode = self.grad_comm
+        block = self.grad_comm_block
+        n = self.mesh.shape["dp"]
+        param_specs = jax.tree.map(
+            lambda s: s.spec, self.state_shardings.params
+        )
+        mstate_specs = jax.tree.map(
+            lambda s: s.spec, self.state_shardings.model_state
+        )
+        from .mesh import BATCH_AXES
+
+        def sync_body(params, model_state, batch, rng, residual):
+            # Decorrelate per-member dropout; identical keys would tie the
+            # masks across batch shards (the auto path draws one global
+            # mask).
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+            (_, (metrics, updates)), grads = jax.value_and_grad(
+                self._loss_and_updates, has_aux=True
+            )(params, model_state, batch, rng, True)
+            residual = jax.tree.map(lambda r: r[0], residual)
+            summed, new_residual = comms_quant.quantized_tree_all_reduce(
+                grads, "dp", mode=mode, block_size=block, residual=residual
+            )
+            grads = jax.tree.map(lambda g: g / n, summed)
+            # Local-batch means -> global-batch means (shards are equal
+            # sized). Non-float model_state (e.g. counters) advances
+            # identically on every member and needs no sync.
+            metrics = jax.tree.map(lambda m: jax.lax.pmean(m, "dp"), metrics)
+            updates = jax.tree.map(
+                lambda u: (
+                    jax.lax.pmean(u, "dp")
+                    if jnp.issubdtype(u.dtype, jnp.inexact) else u
+                ),
+                updates,
+            )
+            new_residual = jax.tree.map(lambda r: r[None], new_residual)
+            return grads, metrics, updates, new_residual
+
+        sync = compat.shard_map(
+            sync_body,
+            mesh=self.mesh,
+            in_specs=(param_specs, mstate_specs, P(BATCH_AXES), P(), P("dp")),
+            out_specs=(param_specs, P(), mstate_specs, P("dp")),
+            check_vma=False,
+        )
+
+        def step_fn(state: TrainState, batch):
+            rng = fold_in_step(state.rng, state.step)
+            grads, metrics, updates, new_residual = sync(
+                state.params, state.model_state, batch, rng,
+                state.grad_residual,
+            )
+            updates_tx, new_opt_state = self._tx_update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates_tx)
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                model_state=updates,
+                grad_residual=new_residual,
+            )
+            return new_state, metrics
+
+        donate = (0,) if self._donate else ()
+        return jax.jit(
+            step_fn,
+            in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=donate,
+        )
+
     def _make_train_step(self):
         # pipeline=False is the sequential parity-oracle mode — it must win
         # over the schedule (the engine would pipeline over pp regardless).
@@ -633,6 +799,8 @@ class Trainer:
             getattr(self.model, "pipeline", True)
         ):
             return self._make_pipeline_train_step()
+        if self.grad_comm != "fp32":
+            return self._make_quantized_dp_train_step()
 
         def step_fn(state: TrainState, batch):
             rng = fold_in_step(state.rng, state.step)

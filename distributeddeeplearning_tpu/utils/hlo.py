@@ -105,5 +105,14 @@ def collective_bytes(hlo_text: str, n_devices: int) -> dict[str, list]:
         else:
             g = _GROUPS_IOTA.search(line)
             group = int(g.group(2)) if g else n_devices
+        if kind == "reduce-scatter" and not m.group("start"):
+            # The sync form's definition type is the SCATTERED output
+            # (full_input / group) while the async ``-start`` tuple's
+            # largest element is the full input — without this the same
+            # program's RS bytes shrank ~group_size-fold depending on
+            # which form the backend emitted. Normalize both to the
+            # full-input convention the docstring (and the ring-cost
+            # formulas downstream) assume.
+            payload *= group
         out[kind].append((payload, group))
     return out

@@ -23,7 +23,25 @@ os.environ["JAX_NUM_CPU_DEVICES"] = "8"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except (AttributeError, ValueError, KeyError):
+    # Older jax (< 0.5) has no jax_num_cpu_devices config; XLA reads
+    # XLA_FLAGS at first backend init, which has not happened yet (importing
+    # jax does not create a client), so the env route still yields 8 devices.
+    # Replace-or-append (XLA honors the FIRST occurrence of the flag) — same
+    # contract as utils/compat.set_cpu_device_env, inlined to keep this
+    # prelude free of package imports.
+    import re as _re
+
+    _flags = os.environ.get("XLA_FLAGS", "")
+    _flag = "--xla_force_host_platform_device_count=8"
+    _pat = _re.compile(r"--xla_force_host_platform_device_count=\d+")
+    if _pat.search(_flags):
+        _flags = _pat.sub(_flag, _flags)
+    else:
+        _flags = (_flags + " " + _flag).strip()
+    os.environ["XLA_FLAGS"] = _flags
 
 # Persistent compilation cache: the suite is compile-dominated (every parity
 # test recompiles ResNet/transformer steps), so cache across runs.

@@ -36,6 +36,45 @@ def _chip_alive(env: dict, timeout: int = 120) -> bool:
     return _CHIP_PROBE["alive"]
 
 
+_TOPO_PROBE: dict = {}
+
+
+def topology_available(topology: str = "v5e:2x2", timeout: int = 90) -> bool:
+    """One cached probe per pytest run: ``get_topology_desc`` can HANG
+    rather than raise in containers whose libtpu probes a live backend at
+    topology-description time — an in-process try/except cannot catch that,
+    so the AOT-topology tests would wedge the whole suite. Probe it in a
+    killable subprocess instead."""
+    if topology not in _TOPO_PROBE:
+        code = (
+            "from jax.experimental import topologies\n"
+            "topologies.get_topology_desc("
+            f"platform='tpu', topology_name={topology!r})\n"
+        )
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                env=env, capture_output=True, timeout=timeout,
+            )
+            _TOPO_PROBE[topology] = proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            _TOPO_PROBE[topology] = False
+    return _TOPO_PROBE[topology]
+
+
+def skip_unless_topology(topology: str = "v5e:2x2") -> None:
+    import pytest
+
+    if not topology_available(topology):
+        pytest.skip(
+            f"deviceless TPU topology {topology!r} unavailable: "
+            "get_topology_desc hangs or fails in this environment "
+            "(probed in a subprocess)"
+        )
+
+
 def run_on_tpu(code: str, timeout: int = 540) -> str:
     """Run a Python snippet in a subprocess against the real TPU chip.
 

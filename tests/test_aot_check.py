@@ -23,6 +23,12 @@ V5E_HBM_BYTES = 16 * 1024**3
 
 @pytest.fixture(scope="module")
 def shrunk(tmp_path_factory):
+    import helpers
+
+    # The tool calls get_topology_desc, which HANGS (not raises) on some
+    # containers — without this probe the fixture burns its full 1800 s
+    # subprocess timeout against a wedged topology client.
+    helpers.skip_unless_topology("v5e:2x2")
     tmp_path = tmp_path_factory.mktemp("aot")
     out = tmp_path / "AOT_TPU_CHECK.json"
     env = dict(os.environ)

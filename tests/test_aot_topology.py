@@ -20,6 +20,8 @@ import pytest
 
 import jax
 
+import helpers
+
 from distributeddeeplearning_tpu import data as data_lib
 from distributeddeeplearning_tpu import models
 from distributeddeeplearning_tpu.mesh import MeshConfig, build_mesh
@@ -34,6 +36,10 @@ _TOPOLOGY = "v5e:2x2"
 
 
 def _topology_devices():
+    # Probe in a subprocess FIRST: on some containers get_topology_desc
+    # hangs (libtpu probes a live backend) instead of raising, which no
+    # in-process except can catch (helpers.topology_available).
+    helpers.skip_unless_topology(_TOPOLOGY)
     try:
         from jax.experimental import topologies
 

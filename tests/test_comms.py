@@ -12,13 +12,14 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributeddeeplearning_tpu import comms
+from distributeddeeplearning_tpu.utils import compat
 
 
 def shmap(f, mesh, in_specs, out_specs):
     # check_vma=False: collectives like all_gather produce value-replicated
     # outputs that the varying-manual-axes checker can't statically prove.
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
         )
     )
@@ -137,7 +138,7 @@ def test_megatron_fg_transposes_under_manual_ad(mesh8):
             (dw,) = vjp(jnp.ones(()))
             return dw[None]
 
-        out = jax.shard_map(
+        out = compat.shard_map(
             body, mesh=mesh8, in_specs=(P(),), out_specs=P("dp"),
             check_vma=False,
         )(jnp.ones(()))
@@ -162,6 +163,8 @@ def test_psum_identity_bwd_types_under_vma_on(mesh8):
     # error under vma-ON shard_map. Pin the stock-config behavior.
     import jax
 
+    if not hasattr(jax.config, "jax_disable_bwd_checks"):
+        pytest.skip("pre-vma jax: no bwd-check machinery to pin")
     old = jax.config.jax_disable_bwd_checks
     jax.config.update("jax_disable_bwd_checks", False)
     try:

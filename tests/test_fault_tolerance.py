@@ -195,10 +195,22 @@ def test_two_process_rendezvous():
     """2-process jax.distributed over localhost: the multi-host init path,
     global mesh construction, and the make_array_from_process_local_data
     branch of sharded_batches — without a cluster."""
+    import jax
+
+    if tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5):
+        # Workers rendezvous fine, but the first jitted computation dies
+        # with "Multiprocess computations aren't implemented on the CPU
+        # backend" — multiprocess CPU landed in jax 0.5.
+        pytest.skip("multiprocess CPU backend requires jax >= 0.5")
     port = _free_port()
     addr = f"localhost:{port}"
+    from distributeddeeplearning_tpu.utils.compat import set_cpu_device_env
+
     env = dict(os.environ)
-    env["JAX_NUM_CPU_DEVICES"] = "4"  # 2 procs x 4 = 8 global devices
+    # 2 procs x 4 = 8 global devices (set_cpu_device_env also rewrites the
+    # inherited 8-device XLA_FLAGS count, which pre-0.5 jax would honor
+    # instead of JAX_NUM_CPU_DEVICES).
+    set_cpu_device_env(env, 4)
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, addr, str(pid)],

@@ -50,6 +50,24 @@ def test_explicit_and_iota_groups_and_default():
     assert got["collective-permute"] == [(400, 8)]
 
 
+def test_sync_reduce_scatter_normalized_to_full_input():
+    # The sync form's definition type is the SCATTERED output (full/group);
+    # the async -start tuple's largest element is the full input. Both must
+    # report the full-input bytes, or the same program's RS traffic shrinks
+    # ~group_size-fold depending on which form the backend emitted.
+    sync = ("%rs = f32[128]{0} reduce-scatter(%x), "
+            "replica_groups=[1,8]<=[8], dimensions={0}, to_apply=%add")
+    astart = "\n".join([
+        "%rss = (f32[1024]{0}, f32[128]{0}) reduce-scatter-start(%x), "
+        "replica_groups=[1,8]<=[8], dimensions={0}, to_apply=%add",
+        "%rsd = f32[128]{0} reduce-scatter-done(%rss)",
+    ])
+    got_sync = collective_bytes(sync, 8)
+    got_async = collective_bytes(astart, 8)
+    assert got_sync["reduce-scatter"] == [(4 * 1024, 8)]
+    assert got_async["reduce-scatter"] == [(4 * 1024, 8)]
+
+
 def test_non_collective_lines_ignored():
     txt = ("%fusion.1 = f32[64]{0} fusion(%p), kind=kLoop, "
            "calls=%fused_computation")

@@ -20,16 +20,19 @@ _TOOL = os.path.join(_REPO, "tools", "measure_tpu.py")
 
 
 def _env(tmp_path, **extra):
+    from distributeddeeplearning_tpu.utils.compat import set_cpu_device_env
+
     env = dict(os.environ)  # conftest already stripped PALLAS_AXON_POOL_IPS
     env.update(
         JAX_PLATFORMS="cpu",
-        JAX_NUM_CPU_DEVICES="1",
         DDL_MEASURE_OUT=str(tmp_path / "TPU_NUMBERS.json"),
         DDL_MEASURE_SHRINK="1",
         DDL_MEASURE_ONLY="resnet18_cifar10",
         **extra,
     )
-    return env
+    # Also rewrites the XLA_FLAGS count inherited from conftest's 8-device
+    # setup — pre-0.5 jax ignores JAX_NUM_CPU_DEVICES and would run on 8.
+    return set_cpu_device_env(env, 1)
 
 
 @pytest.fixture(scope="module")
