@@ -89,11 +89,27 @@ def _step_cost_analysis(step, state, batch) -> dict:
         return {}
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
 def run_benchmark(
-    cfg: Config, *, warmup: int = 5, steps: int = 30
+    cfg: Config, *, warmup: int = 5, steps: int = 30,
+    latency_steps: int | None = None, fused_probe: int | None = None,
 ) -> dict:
     """Time ``steps`` train steps of the config's workload. Returns the
-    one-line JSON record the driver contract expects."""
+    one-line JSON record the driver contract expects.
+
+    Beyond mean steps/s, the record carries the host-dispatch picture:
+    ``p50_step_ms``/``p90_step_ms`` from a per-step-synchronized window
+    (``latency_steps`` steps, each bounded by ``block_until_ready`` — the
+    full dispatch+compute+readback round trip), and — when ``fused_probe``
+    (default: ``cfg.train.steps_per_call`` if > 1, else 8) allows —
+    ``fused_steps_per_sec`` from a K-step fused-scan window plus
+    ``dispatch_overhead_ms_per_step``, the unfused-minus-fused per-step
+    delta: an estimate of what one host dispatch costs this config."""
     from .cli import build_all
 
     mesh, _, trainer, dataset = build_all(cfg)
@@ -158,6 +174,58 @@ def run_benchmark(
         "platform": jax.default_backend(),
         "loss": float(metrics["loss"]),
     }
+
+    # Per-step latency distribution: each step individually fenced, so these
+    # are full host-round-trip times (dispatch + compute + readback), unlike
+    # the pipelined mean above — the spread between the two IS the dispatch
+    # pipelining win. Nearest-rank p50/p90 over a short synchronized window.
+    if latency_steps is None:
+        latency_steps = min(steps, 12)
+    if latency_steps:
+        lats = []
+        for i in range(latency_steps):
+            t = time.perf_counter()
+            state, _ = step(state, staged[i % n_staged])
+            jax.block_until_ready(state)
+            lats.append(time.perf_counter() - t)
+        lats.sort()
+        record["p50_step_ms"] = round(_percentile(lats, 0.5) * 1e3, 3)
+        record["p90_step_ms"] = round(_percentile(lats, 0.9) * 1e3, 3)
+
+    # Fused-dispatch probe: the same step body scanned K-per-call
+    # (Trainer.fused_train_step). The unfused-vs-fused per-step delta
+    # estimates host-dispatch overhead — the quantity steps_per_call exists
+    # to amortize. Probe disabled with fused_probe<=1 or when the model's
+    # step count is too small to time a call.
+    if fused_probe is None:
+        fused_probe = (
+            cfg.train.steps_per_call if cfg.train.steps_per_call > 1 else 8
+        )
+    if fused_probe > 1:
+        super_it = data_lib.sharded_superbatches(
+            dataset.iter_from(0), mesh, fused_probe
+        )
+        staged_super = [next(super_it) for _ in range(2)]
+        jax.block_until_ready(staged_super)
+        fstep = trainer.fused_train_step(fused_probe)
+        state, fmetrics = fstep(state, staged_super[0])  # compile + warm
+        jax.block_until_ready(state)
+        float(jax.tree.leaves(fmetrics)[0][-1])  # metrics are stacked [K]
+        n_calls = max(2, steps // fused_probe)
+        t0 = time.perf_counter()
+        for i in range(n_calls):
+            state, fmetrics = fstep(state, staged_super[i % 2])
+        jax.block_until_ready(state)
+        float(jax.tree.leaves(fmetrics)[0][-1])  # metrics are stacked [K]
+        fused_elapsed = time.perf_counter() - t0
+        fused_sps = n_calls * fused_probe / fused_elapsed
+        record["steps_per_call_probe"] = fused_probe
+        record["fused_steps_per_sec"] = round(fused_sps, 4)
+        # Signed on purpose: a negative value means fusion LOST (e.g. the
+        # scanned program spills) — that must be visible, not clamped away.
+        record["dispatch_overhead_ms_per_step"] = round(
+            (elapsed / steps - 1.0 / fused_sps) * 1e3, 3
+        )
     # Gradient-sync wire bytes per member per step under the configured
     # grad_comm mode (analytic ring model, parallel/fsdp.grad_sync_bytes) —
     # the byte side of the compressed-collectives win (comms_quant.py): an

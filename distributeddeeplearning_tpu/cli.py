@@ -31,6 +31,11 @@ def build_all(cfg: Config, split: str = "train", devices=None):
     ``devices`` overrides the mesh's device set — tools/aot_tpu_check.py
     passes ABSTRACT topology devices to AOT-compile the exact train step a
     real run of this config would execute."""
+    from .utils.compat import enable_compile_cache
+
+    # Before any compile this config triggers: every subcommand funnels
+    # through build_all, so train/eval/benchmark/generate all warm-start.
+    enable_compile_cache(cfg.train.compile_cache_dir)
     mesh = build_mesh(cfg.mesh, devices=devices)
     model = models.get_model(cfg.model.name, **cfg.model.kwargs)
     # Mesh-aware models (ring/Ulysses attention, pipelined stacks) need the
@@ -247,6 +252,19 @@ def cmd_generate(cfg: Config, prompts: list[str], max_new_tokens: int,
 
 
 def cmd_train(cfg: Config) -> int:
+    from .train import check_fusion_cadences
+
+    # Cadence fences BEFORE the (expensive) model build: a steps_per_call
+    # that can't compose with the configured boundaries fails in
+    # milliseconds, by name. fit() re-checks with the resume step.
+    check_fusion_cadences(
+        cfg.train.steps_per_call,
+        steps=cfg.train.steps,
+        log_every=cfg.train.log_every,
+        eval_every=cfg.train.eval_every,
+        save_every=cfg.train.save_every,
+        fault_step=parse_fault_injection(cfg.train.fault_injection),
+    )
     if cfg.train.debug_nans:
         jax.config.update("jax_debug_nans", True)
     if cfg.train.debug_checks:
@@ -274,9 +292,16 @@ def cmd_train(cfg: Config) -> int:
         state = trainer.init(cfg.train.seed, dataset.batch(0))
     print(f"model: {cfg.model.name}  params: {tree_size(state.params):,}")
 
-    batches = data_lib.prefetch(
-        data_lib.sharded_batches(dataset.iter_from(start_index), mesh)
+    # Fused dispatch (steps_per_call > 1) consumes stacked super-batches;
+    # prefetch keeps `prefetch_size` placed (super-)batches in flight so
+    # H2D overlaps the compiled call either way.
+    raw = dataset.iter_from(start_index)
+    placed = (
+        data_lib.sharded_superbatches(raw, mesh, cfg.train.steps_per_call)
+        if cfg.train.steps_per_call > 1
+        else data_lib.sharded_batches(raw, mesh)
     )
+    batches = data_lib.prefetch(placed, size=cfg.data.prefetch_size)
     writer = MetricWriter(cfg.train.log_dir)
     profiler = Profiler(cfg.train.profile_steps, cfg.train.log_dir)
     try:
@@ -286,6 +311,7 @@ def cmd_train(cfg: Config) -> int:
             batches,
             steps=cfg.train.steps,
             log_every=cfg.train.log_every,
+            steps_per_call=cfg.train.steps_per_call,
             log_fn=lambda m: print(json.dumps(m)),
             writer=writer,
             profiler=profiler,

@@ -52,6 +52,11 @@ class DataConfig:
     path: str = ""  # record_file_image / token_file_*: data file
     num_threads: int = 2  # native loader worker threads
     prefetch_depth: int = 4  # native loader ring depth
+    # Device-batch prefetch depth (data.prefetch): how many placed batches
+    # stay in flight ahead of the step loop so H2D overlaps compute. Raise
+    # when input transfer shows up between steps in the profile; each unit
+    # holds one (super-)batch in HBM.
+    prefetch_size: int = 2
     # Vision training augmentation (record_file_image): deterministic
     # random pad+crop / horizontal flip (data.augment_images). The eval
     # split always runs with augmentation off.
@@ -123,6 +128,12 @@ class OptimConfig:
 class TrainConfig:
     steps: int = 100
     log_every: int = 10
+    # Fused multi-step dispatch (Trainer.fused_train_step): K > 1 runs K
+    # train steps per compiled call via an on-device lax.scan over a stacked
+    # super-batch — one host dispatch per K steps. K must divide steps and
+    # the log/eval/save/fault cadences (train.check_fusion_cadences); keep 1
+    # for fault-injection/debug runs where the host needs per-step control.
+    steps_per_call: int = 1
     seed: int = 0
     task: str = "classification"
     grad_accum: int = 1
@@ -147,6 +158,11 @@ class TrainConfig:
     # modes are pure-DP only in v1 (the Trainer fences compositions).
     grad_comm: str = "fp32"
     grad_comm_block: int = 256  # int8 quantization block size (elements)
+    # Persistent XLA compilation cache (jax_compilation_cache_dir): real
+    # runs warm-start their compiles across restarts/resumes — previously
+    # only the test harness set this (tests/conftest.py). Applied by
+    # cli.build_all via compat.enable_compile_cache; empty = off.
+    compile_cache_dir: str = ""
     log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
     # Debug/fault tooling (SURVEY §5): the XLA-world equivalents of the

@@ -245,13 +245,16 @@ def make_dataset(kind: str, **kwargs):
     return DATASET_KINDS[kind](**kwargs)
 
 
-def sharded_batches(it, mesh) -> Iterator:
+def sharded_batches(it, mesh, *, sharding=None, batch_dim: int = 0) -> Iterator:
     """Place each host batch on the mesh, batch dim sharded over (dp, fsdp).
 
     Single-host: ``device_put`` of the global batch. Multi-host: each process
-    holds its local slice and contributes it to a global array.
+    holds its local slice and contributes it to a global array. ``sharding``/
+    ``batch_dim`` override the placement for stacked super-batches (the batch
+    dim moves to 1; see :func:`sharded_superbatches`).
     """
-    sharding = batch_sharding(mesh)
+    if sharding is None:
+        sharding = batch_sharding(mesh)
     n_proc = jax.process_count()
     proc = jax.process_index()
     for batch in it:
@@ -259,19 +262,55 @@ def sharded_batches(it, mesh) -> Iterator:
             # Each generator yields the *global* batch deterministically; this
             # process contributes only its contiguous slice of it.
             def _local(x):
-                if x.shape[0] % n_proc:
+                if x.shape[batch_dim] % n_proc:
                     raise ValueError(
-                        f"batch dim {x.shape[0]} not divisible by "
+                        f"batch dim {x.shape[batch_dim]} not divisible by "
                         f"{n_proc} processes"
                     )
-                per = x.shape[0] // n_proc
+                per = x.shape[batch_dim] // n_proc
+                idx = [slice(None)] * x.ndim
+                idx[batch_dim] = slice(proc * per, (proc + 1) * per)
                 return jax.make_array_from_process_local_data(
-                    sharding, x[proc * per : (proc + 1) * per]
+                    sharding, x[tuple(idx)]
                 )
 
             yield jax.tree.map(_local, batch)
         else:
             yield jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def stacked_batches(it, steps_per_call: int) -> Iterator:
+    """Group ``steps_per_call`` consecutive host batches into one stacked
+    super-batch (leaves ``[K, B, ...]``) for fused K-step dispatch. Stacking
+    happens HOST-side (numpy), so the super-batch crosses H2D as one transfer
+    that prefetch can overlap with the previous fused call. A partial tail
+    group (fewer than K batches left) is dropped — fused runs fence their
+    step counts to multiples of K (``train.check_fusion_cadences``), so a
+    partial group is only ever the dead tail of a finite iterator."""
+    import itertools
+
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call={steps_per_call} must be >= 1")
+    it = iter(it)
+    while True:
+        group = list(itertools.islice(it, steps_per_call))
+        if len(group) < steps_per_call:
+            return
+        yield jax.tree.map(lambda *xs: np.stack(xs), *group)
+
+
+def sharded_superbatches(it, mesh, steps_per_call: int) -> Iterator:
+    """Stack + place super-batches for ``fit(steps_per_call=K)``: leaves
+    ``[K, B, ...]`` with the scan dim replicated and the batch dim sharded
+    over (dp, fsdp)."""
+    from .sharding import super_batch_sharding
+
+    return sharded_batches(
+        stacked_batches(it, steps_per_call),
+        mesh,
+        sharding=super_batch_sharding(mesh),
+        batch_dim=1,
+    )
 
 
 def prefetch(it, size: int = 2) -> Iterator:

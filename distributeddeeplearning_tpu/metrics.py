@@ -12,6 +12,48 @@ from __future__ import annotations
 import jax
 
 
+class DeferredMetrics:
+    """One-interval-lag metric fetch: the non-blocking logging path.
+
+    ``push(step, metrics)`` starts an async D2H copy of the interval's
+    device scalars and emits the PREVIOUS interval's values — which have had
+    a full logging interval to arrive, so the ``float()`` there finds host
+    memory already populated and the dispatch queue never drains for
+    observability (the ``float(v)``-per-metric path stalled it every
+    ``log_every`` steps). ``flush()`` materializes the last pending interval;
+    the loop calls it before returning (and before evals / injected faults)
+    so no line is lost.
+
+    Contract, exactly: after ``push(n)``, intervals ``1..n-1`` have been
+    emitted and ``n`` is pending; ``flush()`` emits the pending one.
+    """
+
+    def __init__(self, emit):
+        self._emit = emit  # emit(dict) — receives {metric: float, step, ...}
+        self._pending = None  # (step, device_metrics, extras)
+
+    def push(self, step: int, metrics: dict, **extras) -> None:
+        for v in jax.tree.leaves(metrics):
+            copy = getattr(v, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+        prev, self._pending = self._pending, (step, metrics, extras)
+        if prev is not None:
+            self._materialize(prev)
+
+    def flush(self) -> None:
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._materialize(pending)
+
+    def _materialize(self, item) -> None:
+        step, metrics, extras = item
+        out = {k: float(v) for k, v in metrics.items()}
+        out["step"] = step
+        out.update(extras)
+        self._emit(out)
+
+
 class MetricWriter:
     """TensorBoard scalar writer (process 0 only); no-op without a logdir."""
 

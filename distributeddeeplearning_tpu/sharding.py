@@ -101,6 +101,17 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, batch_spec())
 
 
+def super_batch_spec() -> P:
+    """PartitionSpec for a [steps_per_call, batch, ...] stacked super-batch
+    (fused K-step dispatch): the scan dim is replicated — every member runs
+    all K steps — and the batch dim shards exactly as a plain batch."""
+    return P(None, BATCH_AXES)
+
+
+def super_batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, super_batch_spec())
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -413,6 +413,7 @@ class Trainer:
         self.zero1 = zero1
         self._donate = donate
         self._train_step = None
+        self._fused_step = None
         self._eval_step = None
         self.state_shardings = None
         self.abstract_state = None
@@ -625,7 +626,7 @@ class Trainer:
                 f"batch size {n}"
             )
 
-    def _make_pipeline_train_step(self):
+    def _pipeline_step_fn(self):
         """schedule='1f1b_interleaved': the pipeline engine computes loss AND
         grads inside one schedule (parallel/pp.interleaved_1f1b), so the step
         skips ``jax.value_and_grad`` entirely; the optimizer update is
@@ -686,18 +687,9 @@ class Trainer:
             )
             return new_state, {"loss": loss}
 
-        donate = (0,) if self._donate else ()
-        return MeshedJit(
-            jax.jit(
-                step_fn,
-                in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
-                out_shardings=(self.state_shardings, None),
-                donate_argnums=donate,
-            ),
-            self.mesh,
-        )
+        return step_fn
 
-    def _make_quantized_dp_train_step(self):
+    def _quantized_dp_step_fn(self):
         """grad_comm in {int8, bf16}: explicit compressed gradient sync.
 
         The auto-sharded path never materializes the gradient all-reduce as
@@ -784,24 +776,9 @@ class Trainer:
             )
             return new_state, metrics
 
-        donate = (0,) if self._donate else ()
-        return jax.jit(
-            step_fn,
-            in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
-            out_shardings=(self.state_shardings, None),
-            donate_argnums=donate,
-        )
+        return step_fn
 
-    def _make_train_step(self):
-        # pipeline=False is the sequential parity-oracle mode — it must win
-        # over the schedule (the engine would pipeline over pp regardless).
-        if getattr(self.model, "schedule", None) == "1f1b_interleaved" and (
-            getattr(self.model, "pipeline", True)
-        ):
-            return self._make_pipeline_train_step()
-        if self.grad_comm != "fp32":
-            return self._make_quantized_dp_train_step()
-
+    def _plain_step_fn(self):
         def step_fn(state: TrainState, batch):
             rng = fold_in_step(state.rng, state.step)
 
@@ -864,16 +841,38 @@ class Trainer:
             )
             return new_state, metrics
 
+        return step_fn
+
+    def _step_fn(self):
+        """(raw ``(state, batch) -> (state, metrics)`` step body, whether it
+        must trace under the activation-mesh context). One selection point so
+        the single-step and the fused K-step programs can never diverge: the
+        fused path scans the SAME body."""
+        # pipeline=False is the sequential parity-oracle mode — it must win
+        # over the schedule (the engine would pipeline over pp regardless).
+        if getattr(self.model, "schedule", None) == "1f1b_interleaved" and (
+            getattr(self.model, "pipeline", True)
+        ):
+            return self._pipeline_step_fn(), True
+        if self.grad_comm != "fp32":
+            # Manual-mode body (shard_map): ``sharding.constrain`` must stay
+            # a no-op, so no MeshedJit (see _quantized_dp_step_fn).
+            return self._quantized_dp_step_fn(), False
+        return self._plain_step_fn(), True
+
+    def _jit_step(self, fn, batch_shardings, meshed: bool):
         donate = (0,) if self._donate else ()
-        return MeshedJit(
-            jax.jit(
-                step_fn,
-                in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
-                out_shardings=(self.state_shardings, None),
-                donate_argnums=donate,
-            ),
-            self.mesh,
+        jitted = jax.jit(
+            fn,
+            in_shardings=(self.state_shardings, batch_shardings),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=donate,
         )
+        return MeshedJit(jitted, self.mesh) if meshed else jitted
+
+    def _make_train_step(self):
+        fn, meshed = self._step_fn()
+        return self._jit_step(fn, batch_sharding(self.mesh), meshed)
 
     @property
     def train_step(self):
@@ -882,6 +881,40 @@ class Trainer:
                 raise RuntimeError("call Trainer.init() before train_step")
             self._train_step = self._make_train_step()
         return self._train_step
+
+    def fused_train_step(self, steps_per_call: int):
+        """K-step fused dispatch: ONE compiled program that ``lax.scan``s the
+        train-step body over a stacked super-batch (leaves ``[K, B, ...]``,
+        batch dim sharded — see ``sharding.super_batch_sharding`` /
+        ``data.sharded_superbatches``). The host dispatches once per K steps,
+        so per-step Python/dispatch overhead amortizes K-fold; per-step
+        metrics come back stacked (leaves ``[K]``). The scanned body IS the
+        single-step body (``_step_fn``), so grad_accum, quantized grad sync,
+        ZeRO-1 and the pipeline schedule compose unchanged, and the per-step
+        RNG stream (``fold_in_step`` of the carried ``state.step``) is
+        identical to K unfused calls. ``steps_per_call=1`` returns
+        ``train_step`` itself — bit-identical to today's loop by construction.
+        """
+        if steps_per_call < 1:
+            raise ValueError(f"steps_per_call={steps_per_call} must be >= 1")
+        if steps_per_call == 1:
+            return self.train_step
+        if self._fused_step is None:
+            if self.state_shardings is None:
+                raise RuntimeError("call Trainer.init() before train_step")
+            fn, meshed = self._step_fn()
+
+            def fused_fn(state: TrainState, super_batch):
+                return jax.lax.scan(fn, state, super_batch)
+
+            from .sharding import super_batch_sharding
+
+            # One wrapper serves every K: jit re-specializes on the
+            # super-batch's leading dim like any other shape.
+            self._fused_step = self._jit_step(
+                fused_fn, super_batch_sharding(self.mesh), meshed
+            )
+        return self._fused_step
 
     @property
     def eval_step(self):
@@ -919,24 +952,78 @@ def evaluate(trainer: Trainer, state: TrainState, batches) -> dict[str, float]:
     """Run ``eval_step`` over an iterable of (sharded) batches and return the
     batch-mean of every metric. The vision tasks report top-1 ``accuracy``
     here — the parity half of the north-star metric (``BASELINE.json:2``:
-    "top-1 parity at 90 epochs")."""
+    "top-1 parity at 90 epochs").
+
+    Metric sums accumulate ON DEVICE and come back in ONE host transfer per
+    pass: the old per-metric-per-batch ``float(v)`` drained the dispatch
+    queue batches*metrics times, serializing eval on host round-trips.
+    """
     import math
 
-    sums: dict[str, float] = {}
+    sums = None
     count = 0
     for batch in batches:
         metrics = trainer.eval_step(state, batch)
-        for k, v in metrics.items():
-            sums[k] = sums.get(k, 0.0) + float(v)
+        sums = (
+            metrics if sums is None
+            else jax.tree.map(jnp.add, sums, metrics)
+        )
         count += 1
     if count == 0:
         raise ValueError("evaluate() got an empty batch iterable")
-    out = {f"eval_{k}": v / count for k, v in sums.items()}
+    sums = jax.device_get(sums)  # the pass's single D2H sync point
+    out = {f"eval_{k}": float(v) / count for k, v in sums.items()}
     if "perplexity" in sums and "loss" in sums:
         # The standard eval number is exp(mean loss); a mean of per-batch
         # exp(loss) would overstate it (Jensen) and drift with batch count.
         out["eval_perplexity"] = math.exp(out["eval_loss"])
     return out
+
+
+def check_fusion_cadences(
+    steps_per_call: int,
+    *,
+    steps: int,
+    start: int = 0,
+    log_every: int = 0,
+    eval_every: int = 0,
+    save_every: int = 0,
+    fault_step: int | None = None,
+) -> None:
+    """Composition fences for fused multi-step dispatch: every host-side
+    boundary (log/eval/save/fault/resume) must land on a fused-call edge,
+    because the host only regains control every ``steps_per_call`` steps.
+    Checked up front so a bad cadence fails by name, not as an off-by-K
+    logging drift ten thousand steps in."""
+    k = steps_per_call
+    if k < 1:
+        raise ValueError(f"steps_per_call={k} must be >= 1")
+    if k == 1:
+        return
+    for name, every in (
+        ("steps", steps),
+        ("log_every", log_every),
+        ("eval_every", eval_every),
+        ("save_every", save_every),
+    ):
+        if every and every % k:
+            raise ValueError(
+                f"steps_per_call={k} must divide {name}={every}: fused calls "
+                f"advance {k} steps at a time, so every cadence boundary has "
+                "to land on a call edge"
+            )
+    if fault_step is not None and fault_step % k:
+        raise ValueError(
+            f"steps_per_call={k} must divide fault_step={fault_step}: the "
+            "injected kill fires between fused calls — use steps_per_call=1 "
+            "for mid-interval fault injection"
+        )
+    if start % k:
+        raise ValueError(
+            f"resume step {start} is not a multiple of steps_per_call={k}: "
+            "align save_every to the fused cadence (it is fenced above) or "
+            "finish the partial interval with steps_per_call=1"
+        )
 
 
 def fit(
@@ -945,6 +1032,7 @@ def fit(
     batches,
     steps: int,
     log_every: int = 10,
+    steps_per_call: int = 1,
     log_fn=print,
     writer=None,
     profiler=None,
@@ -957,11 +1045,22 @@ def fit(
     """Host step loop.
 
     Resumes from ``state.step`` (callers align ``batches`` to the same
-    index). Metrics are pulled to host only every ``log_every`` steps;
-    checkpoint saves are async and off the loop. ``fault_step`` hard-kills
+    index). Metrics are pulled to host only every ``log_every`` steps, and
+    asynchronously (``metrics.DeferredMetrics``): a log boundary STARTS a
+    D2H copy and emits the PREVIOUS boundary's already-arrived values — one
+    interval of lag, zero dispatch-queue drains for observability (the
+    final interval flushes before return, so history is always complete).
+    Checkpoint saves are async and off the loop. ``fault_step`` hard-kills
     the process (no cleanup, simulating a crash) before running that step —
     the test hook for the restart-based recovery flow (SURVEY §5): relaunch
     resumes from the last durable orbax checkpoint.
+
+    ``steps_per_call`` = K > 1 fuses K steps into one on-device scan
+    (:meth:`Trainer.fused_train_step`): ``batches`` must then yield stacked
+    super-batches (leaves ``[K, B, ...]`` — ``data.sharded_superbatches``),
+    and K must divide ``steps`` and every log/eval/save/fault cadence
+    (:func:`check_fusion_cadences`). K=1 is bit-identical to the unfused
+    loop — it IS the unfused loop.
 
     ``eval_every`` > 0 runs :func:`evaluate` over ``eval_fn()`` (a callable
     returning a fresh iterable of sharded eval batches) every that many
@@ -971,24 +1070,42 @@ def fit(
     import os
     import sys
 
+    from .metrics import DeferredMetrics
+
     if eval_every and eval_fn is None:
         raise ValueError("eval_every > 0 requires eval_fn")
+    k = steps_per_call
+    start = int(state.step)
+    check_fusion_cadences(
+        k, steps=steps, start=start, log_every=log_every,
+        eval_every=eval_every, save_every=save_every, fault_step=fault_step,
+    )
+    step_call = trainer.train_step if k == 1 else trainer.fused_train_step(k)
 
-    def run_eval(i):
-        m = evaluate(trainer, state, eval_fn())
-        m["step"] = i + 1
+    history = []
+
+    def emit(m):
         history.append(m)
         log_fn(m)
         if writer is not None:
-            writer.write(i + 1, {k: v for k, v in m.items() if k != "step"})
+            writer.write(m["step"], {x: v for x, v in m.items() if x != "step"})
 
-    history = []
-    start = int(state.step)
+    deferred = DeferredMetrics(emit)
+
+    def run_eval(end):
+        # evaluate() is a sync point anyway; draining the deferred log first
+        # keeps the train line for step N ahead of its eval line.
+        deferred.flush()
+        m = evaluate(trainer, state, eval_fn())
+        m["step"] = end
+        emit(m)
+
     t0 = time.perf_counter()
     it = iter(batches)
-    i = start - 1
-    for i in range(start, steps):
+    end = start
+    for i in range(start, steps, k):
         if fault_step is not None and i == fault_step:
+            deferred.flush()  # the previous interval's line survives the kill
             print(f"fault injection: killing process before step {i}")
             sys.stdout.flush()
             os._exit(17)  # crash semantics: no atexit, no async-save drain
@@ -996,21 +1113,24 @@ def fit(
             batch = next(it)
         except StopIteration:
             break
-        state, metrics = trainer.train_step(state, batch)
+        state, metrics = step_call(state, batch)
+        end = i + k
         if profiler is not None:
-            profiler.step(i)
-        if log_every and (i + 1) % log_every == 0:
-            m = {k: float(v) for k, v in metrics.items()}
-            m["step"] = i + 1
-            m["wall_s"] = round(time.perf_counter() - t0, 3)
-            history.append(m)
-            log_fn(m)
-            if writer is not None:
-                writer.write(i + 1, {k: v for k, v in m.items() if k != "step"})
-        if eval_every and (i + 1) % eval_every == 0:
-            run_eval(i)
-        if ckpt is not None and save_every and (i + 1) % save_every == 0:
-            ckpt.save(i + 1, state, {"next_index": i + 1})
+            # Per-step granularity for the window bounds; under fusion the
+            # trace start/stop still only take effect at call edges.
+            for j in range(i, end):
+                profiler.step(j)
+        if log_every and end % log_every == 0:
+            # Fused metrics come back stacked [K]; the logged step is the
+            # interval's last, same as the unfused loop.
+            last = metrics if k == 1 else jax.tree.map(lambda v: v[-1], metrics)
+            deferred.push(
+                end, last, wall_s=round(time.perf_counter() - t0, 3)
+            )
+        if eval_every and end % eval_every == 0:
+            run_eval(end)
+        if ckpt is not None and save_every and end % save_every == 0:
+            ckpt.save(end, state, {"next_index": end})
             if fault_step is not None:
                 # Fault injection simulates a crash at an arbitrary step; the
                 # recovery contract is "resume from the last DURABLE save".
@@ -1018,8 +1138,9 @@ def fit(
                 # crash→resume test is deterministic instead of racing the
                 # async writer (ADVICE.md r1).
                 ckpt.wait()
-    if eval_every and (i + 1) % eval_every != 0 and i >= start:
-        run_eval(i)  # final eval so short runs still report one
+    if eval_every and end % eval_every != 0 and end > start:
+        run_eval(end)  # final eval so short runs still report one
+    deferred.flush()
     if profiler is not None:
         profiler.close()
     if writer is not None:

@@ -45,6 +45,31 @@ def set_cpu_device_env(env, n: int):
     return env
 
 
+def enable_compile_cache(path: str) -> bool:
+    """Point jax's persistent compilation cache at ``path`` (no-op for "").
+
+    Thresholds are set so even the small configs' steps persist (min compile
+    time 1s, no size floor — same values the test harness uses). The
+    threshold knobs are version-guarded: the cache-dir option itself exists
+    on every release this repo supports, the tuning knobs came later.
+    Returns whether a cache was enabled.
+    """
+    if not path:
+        return False
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    for name, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 1.0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(name, value)
+        except (AttributeError, ValueError, KeyError):
+            pass
+    return True
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     """``jax.shard_map`` across jax versions.
 

@@ -69,6 +69,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: needs the real TPU chip (runs in a subprocess)"
     )
+    # Tier-1 runs `-m 'not slow'` (ROADMAP.md): heavy-but-redundant cases
+    # (e.g. the K>1 fused parity over a pipelined model, whose single-step
+    # twin already covers the schedule) opt out of the fast lane here.
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy parity cases excluded from the tier-1 fast lane",
+    )
 
 
 @pytest.fixture

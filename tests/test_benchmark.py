@@ -33,7 +33,7 @@ def _tiny_cfg():
 
 
 def test_run_benchmark_record_contract():
-    record = run_benchmark(_tiny_cfg(), warmup=2, steps=3)
+    record = run_benchmark(_tiny_cfg(), warmup=2, steps=3, fused_probe=0)
     assert record["value"] > 0
     assert record["steps_per_sec"] > 0
     assert record["unit"] == "images/sec/chip"
@@ -45,13 +45,34 @@ def test_run_benchmark_record_contract():
     # "plugin doesn't report", distinguishable from "not recorded".
     assert "hbm_peak_bytes" in record
     assert record["hbm_peak_bytes"] is None
+    # Per-step latency percentiles ride along by default (dispatch-overhead
+    # telemetry): nearest-rank over a synchronized window, so p90 >= p50.
+    assert record["p90_step_ms"] >= record["p50_step_ms"] > 0
     # The record must be JSON-serializable as-is (driver contract: one line).
     json.dumps(record)
 
 
 def test_run_benchmark_zero_warmup_is_legal():
-    record = run_benchmark(_tiny_cfg(), warmup=0, steps=2)
+    record = run_benchmark(
+        _tiny_cfg(), warmup=0, steps=2, latency_steps=0, fused_probe=0
+    )
     assert record["value"] > 0
+    # Both probe windows disabled -> none of their keys leak into the record.
+    for key in ("p50_step_ms", "p90_step_ms", "steps_per_call_probe",
+                "fused_steps_per_sec", "dispatch_overhead_ms_per_step"):
+        assert key not in record
+
+
+def test_run_benchmark_fused_probe_fields():
+    # The fused-dispatch probe quantifies what steps_per_call amortizes:
+    # an unfused-minus-fused per-step delta (signed — fusion may LOSE).
+    record = run_benchmark(
+        _tiny_cfg(), warmup=1, steps=4, latency_steps=2, fused_probe=2
+    )
+    assert record["steps_per_call_probe"] == 2
+    assert record["fused_steps_per_sec"] > 0
+    assert isinstance(record["dispatch_overhead_ms_per_step"], float)
+    json.dumps(record)
 
 
 def test_vs_baseline_unknown_metric_is_null(tmp_path):

@@ -134,3 +134,32 @@ def test_eval_seed_selects_heldout_split(mesh8):
     ds_a = data_lib.make_dataset(cfg.data.kind, **train_kw)
     ds_b = data_lib.make_dataset(cfg.data.kind, **eval_kw)
     assert not (ds_a.batch(0)["image"] == ds_b.batch(0)["image"]).all()
+
+
+def test_evaluate_single_host_pull_per_pass(mesh8, monkeypatch):
+    # Metric sums accumulate on device; the whole pass costs exactly ONE
+    # jax.device_get, regardless of batch count (the old loop pulled
+    # batches x metrics scalars, serializing eval on host round-trips).
+    import itertools
+
+    import jax
+
+    from distributeddeeplearning_tpu import train as train_mod
+
+    trainer, ds = _trainer_and_data(mesh8)
+    state = trainer.init(0, ds.batch(0))
+    batches = list(data_lib.sharded_batches(
+        itertools.islice(ds.iter_from(0), 6), mesh8
+    ))
+
+    pulls = []
+    real_device_get = jax.device_get
+
+    def spy(tree):
+        pulls.append(tree)
+        return real_device_get(tree)
+
+    monkeypatch.setattr(train_mod.jax, "device_get", spy)
+    metrics = evaluate(trainer, state, iter(batches))
+    assert len(pulls) == 1, f"expected 1 host pull, saw {len(pulls)}"
+    assert 0.0 <= metrics["eval_accuracy"] <= 1.0

@@ -172,7 +172,10 @@ def run(steps: int, out_dir: str, train_path: str, eval_path: str,
     )
     mesh, _, trainer, dataset = build_all(cfg)
     state = trainer.init(cfg.train.seed, dataset.batch(0))
-    batches = prefetch(sharded_batches(dataset.iter_from(0), mesh))
+    batches = prefetch(
+        sharded_batches(dataset.iter_from(0), mesh),
+        size=cfg.data.prefetch_size,
+    )
     ckpt = CheckpointManager(ckpt_dir)
     t1 = time.time()
     try:
