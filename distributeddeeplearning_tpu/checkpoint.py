@@ -6,11 +6,21 @@ and restores directly into the live mesh's NamedShardings — including into a
 *different* mesh shape than the one that saved (tested in
 ``tests/test_checkpoint.py``). Data-iterator position travels with the model
 state so resume is step-exact.
+
+Robustness (docs/FAULT_TOLERANCE.md): a crash can leave the newest
+checkpoint unreadable (a preempted writer, a bad disk). ``restore`` with no
+explicit step therefore walks steps newest-first and falls back to the
+newest EARLIER durable step when the latest fails to deserialize — only
+failing when NO step restores. ``corrupt_latest_for_test`` is the
+deterministic chaos hook (``fault_injection=corrupt:K``) that manufactures
+exactly that situation.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import sys
 
 import orbax.checkpoint as ocp
 
@@ -31,14 +41,19 @@ class CheckpointManager:
         save_interval_steps: int = 1,
         async_save: bool = True,
     ):
+        self._directory = os.path.abspath(directory)  # orbax rejects relative
         self._mngr = ocp.CheckpointManager(
-            os.path.abspath(directory),  # orbax rejects relative paths
+            self._directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
                 enable_async_checkpointing=async_save,
             ),
         )
+
+    @property
+    def directory(self) -> str:
+        return self._directory
 
     def save(self, step: int, state: TrainState, data_state: dict | None = None,
              force: bool = False) -> bool:
@@ -51,17 +66,7 @@ class CheckpointManager:
             force=force,
         )
 
-    def restore(self, abstract_state, step: int | None = None):
-        """Restore (state, data_state) at ``step`` (default: latest).
-
-        ``abstract_state``: ShapeDtypeStructs with shardings
-        (``Trainer.abstract_state_with_shardings()``) — orbax reads each shard
-        straight into its device placement.
-        """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError("no checkpoint found")
+    def _restore_step(self, step: int, abstract_state):
         out = self._mngr.restore(
             step,
             args=ocp.args.Composite(
@@ -71,8 +76,189 @@ class CheckpointManager:
         )
         return out["state"], dict(out["data"] or {})
 
+    def _step_dir(self, step: int) -> str | None:
+        """Directory of a step on disk. Orbax names step dirs by the bare
+        step number (possibly under a step_name_format): match any directory
+        whose digits equal ``step``."""
+        try:
+            names = os.listdir(self._directory)
+        except OSError:
+            return None
+        for name in names:
+            if name.endswith(".corrupt"):  # quarantined — no longer a step
+                continue
+            digits = "".join(c for c in name if c.isdigit())
+            path = os.path.join(self._directory, name)
+            if os.path.isdir(path) and digits and int(digits) == step:
+                return path
+        return None
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        """Rename a corrupt step dir to ``<name>.corrupt`` so orbax never
+        sees it again. Merely skipping is not enough: the manager still
+        lists the step, ``save(step)`` silently no-ops against the truncated
+        dir, and any later native read of its zero-byte files can corrupt
+        the process heap (see ``_corrupt_reason``). After the rename the
+        step is simply absent — ``latest_step()`` moves back to the newest
+        durable step and re-saving the quarantined step is a fresh save."""
+        d = self._step_dir(step)
+        if d is None:
+            return
+        try:
+            os.rename(d, d + ".corrupt")
+        except OSError:
+            return
+        print(
+            f"WARNING: quarantined corrupt checkpoint step {step} "
+            f"({reason}) -> {os.path.basename(d)}.corrupt",
+            file=sys.stderr,
+            flush=True,
+        )
+        try:
+            self._mngr.reload()  # drop the manager's cached step listing
+        except Exception:
+            pass
+
+    def _corrupt_reason(self, step: int) -> str | None:
+        """Cheap host-side structural check BEFORE handing a step to orbax
+        — returns a reason when the step is visibly corrupt, None when it
+        looks restorable.
+
+        This must run first, not as a try/except around restore: the pinned
+        orbax/tensorstore can corrupt the process heap when fed truncated
+        files (the Python exception is catchable but the process later
+        aborts in unrelated native code), so "attempt and fall back" is not
+        a safe probe. Truncation — the signature of a writer killed
+        mid-flight, and of ``corrupt_latest_for_test`` — shows up as
+        zero-byte manifest/metadata/chunk files and unparseable JSON
+        metadata, all checkable with plain host I/O."""
+        d = self._step_dir(step)
+        if d is None:
+            return None  # unknown layout: let orbax decide
+        try:
+            paths = [
+                os.path.join(root, f)
+                for root, _, files in os.walk(d)
+                for f in files
+            ]
+            if not paths:
+                return "empty step directory"
+            for p in paths:
+                rel = os.path.relpath(p, d)
+                base = os.path.basename(p)
+                critical = (
+                    base in ("_CHECKPOINT_METADATA", "_METADATA",
+                             "metadata", "_sharding")
+                    or base.endswith(".ocdbt")
+                    or "d" in rel.split(os.sep)[:-1]  # tensorstore chunks
+                )
+                if critical and os.path.getsize(p) == 0:
+                    return f"zero-byte {rel}"
+            meta = os.path.join(d, "_CHECKPOINT_METADATA")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    json.load(f)
+        except OSError:
+            return None  # can't inspect: let orbax decide
+        except ValueError as e:
+            return f"unparseable _CHECKPOINT_METADATA ({e})"
+        return None
+
+    def restore(self, abstract_state, step: int | None = None):
+        """Restore (state, data_state) at ``step`` (default: newest
+        RESTORABLE).
+
+        ``abstract_state``: ShapeDtypeStructs with shardings
+        (``Trainer.abstract_state_with_shardings()``) — orbax reads each shard
+        straight into its device placement.
+
+        An explicit ``step`` restores exactly that step or raises — it never
+        silently substitutes another. With no step, a finalized-but-
+        unreadable newest checkpoint (truncated files, a half-written shard)
+        logs a warning, quarantines the bad step dir (``<name>.corrupt``),
+        and falls back to the next newer-to-older durable
+        step; only when NO step restores does the call fail — resume then
+        loses ``save_every`` steps instead of the whole run.
+        """
+        if step is not None:
+            reason = self._corrupt_reason(step)
+            if reason is not None:
+                raise RuntimeError(
+                    f"checkpoint step {step} in {self._directory} is "
+                    f"corrupt: {reason}"
+                )
+            return self._restore_step(step, abstract_state)
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError("no checkpoint found")
+        last_err: Exception | None = None
+        for s in steps:
+            reason = self._corrupt_reason(s)
+            if reason is not None:
+                last_err = RuntimeError(f"step {s}: {reason}")
+                print(
+                    f"WARNING: checkpoint step {s} in {self._directory} "
+                    f"is corrupt ({reason}) — falling back to an earlier "
+                    "durable step",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                self._quarantine(s, reason)
+                continue
+            try:
+                out = self._restore_step(s, abstract_state)
+            except Exception as e:  # orbax corrupt-data errors vary by layer
+                last_err = e
+                print(
+                    f"WARNING: checkpoint step {s} in {self._directory} "
+                    f"failed to restore ({type(e).__name__}) — falling back "
+                    "to an earlier durable step",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                continue
+            if s != steps[0]:
+                print(
+                    f"WARNING: restored fallback checkpoint step {s} "
+                    f"(latest was {steps[0]})",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            return out
+        raise RuntimeError(
+            f"no restorable checkpoint in {self._directory}: "
+            f"all of steps {steps} failed to deserialize"
+        ) from last_err
+
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
+    def corrupt_latest_for_test(self, step: int | None = None) -> int | None:
+        """Chaos hook (``fault_injection=corrupt:K``; tools/chaos_run.py):
+        truncate every file of the LATEST finalized checkpoint step in place
+        (or an explicit ``step`` — orbax still lists already-truncated steps
+        as "latest", so tests corrupting more than one step name them), so a
+        subsequent ``restore()`` must exercise the fallback path. Returns the
+        corrupted step (None when there is nothing to corrupt). Test-only by
+        contract: never called outside fault injection."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        step_dir = self._step_dir(step)
+        if step_dir is None:
+            return None
+        for root, _, files in os.walk(step_dir):
+            for f in files:
+                try:
+                    with open(os.path.join(root, f), "wb"):
+                        pass  # truncate to zero bytes
+                except OSError:
+                    pass
+        return step
 
     def wait(self):
         """Block until pending async saves are durable."""

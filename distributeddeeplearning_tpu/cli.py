@@ -22,7 +22,8 @@ from .train import Trainer, fit, get_task, make_optimizer, parse_fault_injection
 from .utils.pytree import tree_size
 
 
-def build_all(cfg: Config, split: str = "train", devices=None):
+def build_all(cfg: Config, split: str = "train", devices=None,
+              fault_nan_step: int | None = None):
     """Construct (mesh, model, trainer, dataset) from a config.
 
     ``split='eval'`` builds the dataset from the eval-split kwargs instead —
@@ -30,7 +31,8 @@ def build_all(cfg: Config, split: str = "train", devices=None):
     data (for record-file kinds that would hold the file in memory twice).
     ``devices`` overrides the mesh's device set — tools/aot_tpu_check.py
     passes ABSTRACT topology devices to AOT-compile the exact train step a
-    real run of this config would execute."""
+    real run of this config would execute. ``fault_nan_step`` compiles the
+    ``nan:K`` gradient-poison fault into the train step (train.py)."""
     from .utils.compat import enable_compile_cache
 
     # Before any compile this config triggers: every subcommand funnels
@@ -86,6 +88,11 @@ def build_all(cfg: Config, split: str = "train", devices=None):
         zero1=cfg.train.zero1,
         grad_comm=cfg.train.grad_comm,
         grad_comm_block=cfg.train.grad_comm_block,
+        # Trainer gates on health.enabled itself; passing it unconditionally
+        # keeps the TrainState schema (health field present/absent)
+        # consistent across train/eval/generate for one config.
+        health=cfg.health,
+        fault_nan_step=fault_nan_step,
         **trainer_kw,
     )
     data_kwargs = (
@@ -251,25 +258,18 @@ def cmd_generate(cfg: Config, prompts: list[str], max_new_tokens: int,
     return 0
 
 
-def cmd_train(cfg: Config) -> int:
-    from .train import check_fusion_cadences
-
-    # Cadence fences BEFORE the (expensive) model build: a steps_per_call
-    # that can't compose with the configured boundaries fails in
-    # milliseconds, by name. fit() re-checks with the resume step.
-    check_fusion_cadences(
-        cfg.train.steps_per_call,
-        steps=cfg.train.steps,
-        log_every=cfg.train.log_every,
-        eval_every=cfg.train.eval_every,
-        save_every=cfg.train.save_every,
-        fault_step=parse_fault_injection(cfg.train.fault_injection),
+def _train_once(cfg: Config, fault) -> int:
+    """One training attempt: build, restore-or-init, fit. Raises
+    ``train.Preempted`` / ``train.HealthRollback`` for ``cmd_train``'s outer
+    policy loop — re-entry restores the latest durable checkpoint, which is
+    the whole rollback mechanism (the data iterator cannot rewind, so
+    rollback == resume)."""
+    mesh, _, trainer, dataset = build_all(
+        cfg,
+        fault_nan_step=(
+            fault.step if fault is not None and fault.kind == "nan" else None
+        ),
     )
-    if cfg.train.debug_nans:
-        jax.config.update("jax_debug_nans", True)
-    if cfg.train.debug_checks:
-        jax.config.update("jax_enable_checks", True)
-    mesh, _, trainer, dataset = build_all(cfg)
     print(f"devices: {jax.device_count()}  mesh: {dict(mesh.shape)}")
 
     ckpt = None
@@ -281,7 +281,8 @@ def cmd_train(cfg: Config) -> int:
         ckpt = CheckpointManager(cfg.train.checkpoint_dir)
         if ckpt.latest_step() is not None:
             # Resume: no init materialization — restore straight into the
-            # mesh placement computed by setup().
+            # mesh placement computed by setup(). restore() falls back to
+            # the newest EARLIER durable step when the latest is corrupt.
             trainer.setup(dataset.batch(0))
             state, data_state = ckpt.restore(
                 trainer.abstract_state_with_shardings()
@@ -317,9 +318,10 @@ def cmd_train(cfg: Config) -> int:
             profiler=profiler,
             ckpt=ckpt,
             save_every=cfg.train.save_every,
-            fault_step=parse_fault_injection(cfg.train.fault_injection),
+            fault=fault,
             eval_every=cfg.train.eval_every,
             eval_fn=make_eval_fn(cfg, mesh) if cfg.train.eval_every else None,
+            health=cfg.health if cfg.health.enabled else None,
         )
     finally:
         # Always drain the async checkpoint queue — an abandoned in-flight
@@ -331,10 +333,103 @@ def cmd_train(cfg: Config) -> int:
     return 0
 
 
+def cmd_train(cfg: Config) -> int:
+    import os
+
+    from .supervisor import ATTEMPT_ENV, EXIT_PREEMPTED
+    from .train import HealthRollback, Preempted, check_fusion_cadences
+
+    fault = parse_fault_injection(cfg.train.fault_injection)
+    attempt = int(os.environ.get(ATTEMPT_ENV, "0") or 0)
+    if fault is not None and attempt > 0:
+        # Injected faults are ONE-SHOT: a supervised restart replays the same
+        # run without re-firing (else step:K would crash-loop forever and
+        # hang:K would re-stall every attempt). Attempt 0 injects; every
+        # restart recovers.
+        print(json.dumps({
+            "event": "fault_disarmed",
+            "attempt": attempt,
+            "fault": f"{fault.kind}:{fault.step}",
+        }))
+        fault = None
+
+    # Cadence fences BEFORE the (expensive) model build: a steps_per_call
+    # that can't compose with the configured boundaries fails in
+    # milliseconds, by name. fit() re-checks with the resume step.
+    check_fusion_cadences(
+        cfg.train.steps_per_call,
+        steps=cfg.train.steps,
+        log_every=cfg.train.log_every,
+        eval_every=cfg.train.eval_every,
+        save_every=cfg.train.save_every,
+        fault=fault,
+    )
+    if cfg.train.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+    if cfg.train.debug_checks:
+        jax.config.update("jax_enable_checks", True)
+
+    rollbacks = 0
+    while True:
+        try:
+            return _train_once(cfg, fault)
+        except Preempted as p:
+            # fit already force-saved synchronously; the exit code tells the
+            # supervisor "done, do not restart".
+            print(json.dumps({
+                "event": "preempted_exit", "step": p.step, "saved": p.saved,
+            }))
+            return EXIT_PREEMPTED
+        except HealthRollback as rb:
+            rollbacks += 1
+            if rollbacks > cfg.health.max_rollbacks:
+                print(json.dumps({
+                    "event": "rollback_give_up",
+                    "rollbacks": rollbacks - 1,
+                    "max_rollbacks": cfg.health.max_rollbacks,
+                    "step": rb.step,
+                }), file=sys.stderr)
+                return 1
+            print(json.dumps({
+                "event": "rollback_restart",
+                "rollbacks": rollbacks,
+                "step": rb.step,
+                "consecutive": rb.consecutive,
+            }))
+            # The retry models a TRANSIENT fault (the dominant real-world
+            # case: a flipped bit, one poisoned batch): replay from the last
+            # durable save with injection disarmed. A deterministic re-fire
+            # would make rollback a loop, not a recovery.
+            fault = None
+
+
+def cmd_supervise(args) -> int:
+    """Run ``train`` under the restart supervisor (``supervisor.py``): the
+    child is this same CLI with the same ``--config``/``--override`` flags;
+    restart/backoff/hang knobs come from the config's ``supervisor`` section.
+    The supervising process itself never touches the accelerator — it is a
+    pure process babysitter, so it can outlive any child crash."""
+    from .supervisor import supervise_command
+
+    cfg = apply_overrides(load_config(args.config), args.override)
+    cmd = [
+        sys.executable, "-m", "distributeddeeplearning_tpu.cli",
+        "train", "--config", args.config,
+    ]
+    for o in args.override:
+        cmd += ["--override", o]
+    if args.xla_perf_flags:
+        cmd.append("--xla-perf-flags")
+    clear = ()
+    if cfg.supervisor.clear_cache_on_crash and cfg.train.compile_cache_dir:
+        clear = (cfg.train.compile_cache_dir,)
+    return supervise_command(cmd, cfg.supervisor, crash_clear_paths=clear)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="distributeddeeplearning_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("train", "eval", "benchmark", "generate"):
+    for name in ("train", "eval", "benchmark", "generate", "supervise"):
         p = sub.add_parser(name)
         p.add_argument("--config", required=True, help="path to a config .py")
         p.add_argument(
@@ -367,6 +462,10 @@ def main(argv=None) -> int:
                 "steady-state tokens/sec",
             )
     args = parser.parse_args(argv)
+    if args.cmd == "supervise":
+        # BEFORE init_distributed: the supervisor must not claim the backend
+        # or the coordinator port its children need.
+        return cmd_supervise(args)
     if args.xla_perf_flags:
         # Env-level, so it must precede EVERY backend touch — including the
         # rendezvous below and anything a config module might do.

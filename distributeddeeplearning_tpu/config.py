@@ -166,13 +166,79 @@ class TrainConfig:
     log_dir: str = ""  # TensorBoard scalars + profiler traces
     profile_steps: str = ""  # "a:b" -> jax.profiler trace window
     # Debug/fault tooling (SURVEY §5): the XLA-world equivalents of the
-    # reference's CUDA sanitizer hooks.
-    fault_injection: str = ""  # "step:K" -> hard-kill the process at step K
+    # reference's CUDA sanitizer hooks. The fault matrix (docs/
+    # FAULT_TOLERANCE.md): "step:K" hard-kills the process before step K;
+    # "nan:K" poisons the gradients of step K on device (needs
+    # health.enabled to recover); "hang:K" stalls the host loop at step K
+    # (the supervisor's heartbeat monitor recovers it); "corrupt:K"
+    # truncates the latest checkpoint at step K then kills (exercises the
+    # restore fallback). Injections fire only on supervisor attempt 0
+    # (DDL_SUPERVISOR_ATTEMPT) so restarts recover rather than re-fault.
+    fault_injection: str = ""
     debug_nans: bool = False  # jax_debug_nans: fail fast on NaN outputs
     debug_checks: bool = False  # jax_enable_checks: internal invariants
     # (async-collective XLA flags are a CLI switch, --xla-perf-flags, not a
     # config field: they must hit the environment before the config module —
     # an arbitrary .py — could touch the backend.)
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """On-device health guard (``health.py``): non-finite loss/grad detection
+    with skip-update semantics, an EMA loss-spike detector, and the host-side
+    rollback policy. Compiled into the train step when ``enabled``."""
+
+    enabled: bool = False
+    # EMA loss tracker: ema <- beta*ema + (1-beta)*loss on healthy steps.
+    ema_beta: float = 0.98
+    # Spike detector: loss > spike_factor * ema (after warmup) counts as an
+    # anomaly and skips the update. 0 = spike detection off (non-finite
+    # detection is always on while enabled).
+    spike_factor: float = 0.0
+    # Healthy steps the EMA must absorb before the spike detector arms —
+    # early-training loss is legitimately volatile.
+    ema_warmup_steps: int = 20
+    # Host-side rollback policy: once this many CONSECUTIVE anomalous steps
+    # are observed (via the logged metric stream, so detection lags one
+    # logging interval), abandon the in-memory state and restore the last
+    # durable checkpoint. 0 = never roll back (skip-update only).
+    max_consecutive_anomalies: int = 0
+    # Rollbacks per process before giving up (the supervisor's restart
+    # budget then takes over).
+    max_rollbacks: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """Restart supervisor (``supervisor.py``) for the ``supervise`` CLI
+    subcommand: classifies child exits, restarts with exponential backoff +
+    jitter, detects hangs via a heartbeat file, and converts SIGTERM/SIGINT
+    into a preemption-safe final save in the child."""
+
+    # Restarts (not counting the first attempt) before giving up.
+    max_restarts: int = 5
+    backoff_base_s: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    # Uniform jitter as a fraction of the backoff delay (decorrelates a
+    # pod's workers re-entering the compile queue together).
+    backoff_jitter: float = 0.1
+    # No heartbeat-file touch for this long -> the child is hung: kill and
+    # restart. 0 = hang detection off. Must exceed the worst-case gap
+    # between heartbeats (first-step compile + one logging interval).
+    hang_timeout_s: float = 0.0
+    poll_interval_s: float = 0.5
+    # After forwarding SIGTERM, how long the child gets for its final
+    # synchronous save before SIGKILL.
+    preempt_grace_s: float = 60.0
+    heartbeat_file: str = ""  # "" -> auto (a temp path per supervisor run)
+    # After a CRASH/HANG exit (not clean/preempted/injected-fault), clear
+    # the child's persistent XLA compile cache before restarting: a child
+    # that died abnormally may have truncated a cache entry mid-write, and
+    # a cached executable can itself be what the child keeps dying on —
+    # recompiling cold is the only restart that makes progress then. Costs
+    # one compile per abnormal restart; disable to keep the cache warm.
+    clear_cache_on_crash: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -182,6 +248,10 @@ class Config:
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
+    supervisor: SupervisorConfig = dataclasses.field(
+        default_factory=SupervisorConfig
+    )
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, default=str)

@@ -1,0 +1,130 @@
+"""On-device training health guard — anomaly detection compiled INTO the
+train step (``HealthConfig``; docs/FAULT_TOLERANCE.md).
+
+A production step loop cannot afford a host round-trip per step to ask "was
+that loss finite?", and under fused dispatch (``steps_per_call=K``) the host
+does not even regain control between steps. So the guard runs inside the
+compiled program:
+
+- **non-finite detection**: ``jnp.isfinite`` on the step's loss and global
+  grad norm (the step bodies surface ``grad_norm`` when a guard is active);
+- **skip-update semantics**: on an anomalous step, ``jnp.where`` selects the
+  PREVIOUS params / opt_state / model_state / grad_residual — the step
+  counter still advances (so the data stream and per-step RNG move on) but
+  the model is bit-identical to not having run the step;
+- **EMA loss-spike detection**: after ``ema_warmup_steps`` healthy steps,
+  ``loss > spike_factor * ema`` also counts as an anomaly (a finite but
+  diverging step is as lost as a NaN one);
+- **anomaly counters** carried in ``TrainState.health`` and surfaced as
+  metrics, so the host-side rollback policy (``train.fit`` /
+  ``cli.cmd_train``) can act on the ordinary logged metric stream with zero
+  extra device syncs.
+
+The guard wraps the raw ``(state, batch) -> (state, metrics)`` step body in
+``Trainer._step_fn`` — BEFORE the fused ``lax.scan`` — so single-step and
+K-fused programs get identical semantics by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .config import HealthConfig
+
+__all__ = [
+    "HealthConfig",
+    "HealthState",
+    "guard_step",
+    "init_health_state",
+]
+
+
+@struct.dataclass
+class HealthState:
+    """Scalar health counters carried in ``TrainState.health`` (replicated;
+    they checkpoint and restore with the rest of the state)."""
+
+    anomaly_count: jax.Array  # i32: total anomalous steps so far
+    consecutive: jax.Array  # i32: current run of anomalous steps
+    loss_ema: jax.Array  # f32: EMA of the loss over healthy steps
+    ema_steps: jax.Array  # i32: healthy steps absorbed by the EMA
+
+
+def init_health_state() -> HealthState:
+    return HealthState(
+        anomaly_count=jnp.zeros((), jnp.int32),
+        consecutive=jnp.zeros((), jnp.int32),
+        loss_ema=jnp.zeros((), jnp.float32),
+        ema_steps=jnp.zeros((), jnp.int32),
+    )
+
+
+def guard_step(step_fn, cfg: HealthConfig):
+    """Wrap a raw step body with anomaly detection + skip-update.
+
+    ``step_fn``: ``(state, batch) -> (new_state, metrics)`` where ``metrics``
+    carries ``loss`` and (when available) ``grad_norm``. The wrapped body adds
+    ``anomalies`` (cumulative), ``consecutive_anomalies`` and ``skipped``
+    (0/1 for this step) to the metrics.
+    """
+
+    def guarded(state, batch):
+        h0 = state.health
+        new_state, metrics = step_fn(state, batch)
+        loss = jnp.asarray(metrics["loss"], jnp.float32)
+        finite = jnp.isfinite(loss)
+        grad_norm = metrics.get("grad_norm")
+        if grad_norm is not None:
+            finite = finite & jnp.isfinite(
+                jnp.asarray(grad_norm, jnp.float32)
+            )
+        ok = finite
+        if cfg.spike_factor > 0:
+            armed = h0.ema_steps >= cfg.ema_warmup_steps
+            spike = armed & finite & (loss > cfg.spike_factor * h0.loss_ema)
+            ok = ok & ~spike
+
+        # Skip-update: the anomalous step leaves the model bit-identical —
+        # jnp.where passes the old value through elementwise. The step
+        # counter (and with it the per-step RNG stream and the host's data
+        # cursor) advances either way, so a single bad batch costs one
+        # update, not a stall.
+        def sel(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+        merged = new_state.replace(
+            params=sel(new_state.params, state.params),
+            opt_state=sel(new_state.opt_state, state.opt_state),
+            model_state=sel(new_state.model_state, state.model_state),
+        )
+        if state.grad_residual is not None:
+            # Error-feedback residuals must not absorb poisoned grads.
+            merged = merged.replace(
+                grad_residual=sel(new_state.grad_residual, state.grad_residual)
+            )
+        # EMA updates only on healthy steps (a NaN would poison it forever);
+        # the first healthy loss seeds it.
+        ema = jnp.where(
+            h0.ema_steps == 0,
+            loss,
+            cfg.ema_beta * h0.loss_ema + (1.0 - cfg.ema_beta) * loss,
+        )
+        bad = (~ok).astype(jnp.int32)
+        h1 = HealthState(
+            anomaly_count=h0.anomaly_count + bad,
+            consecutive=jnp.where(ok, 0, h0.consecutive + 1).astype(jnp.int32),
+            loss_ema=jnp.where(ok, ema, h0.loss_ema),
+            ema_steps=jnp.where(ok, h0.ema_steps + 1, h0.ema_steps),
+        )
+        merged = merged.replace(health=h1)
+        metrics = {
+            **metrics,
+            "anomalies": h1.anomaly_count,
+            "consecutive_anomalies": h1.consecutive,
+            "skipped": bad,
+        }
+        return merged, metrics
+
+    return guarded

@@ -12,6 +12,15 @@ from __future__ import annotations
 import jax
 
 
+def event_record(name: str, step: int, **fields) -> dict:
+    """A loop-status EVENT as a metrics-stream record: ``{"event": name,
+    "step": step, ...}``. Events ride the same emit path as metric lines
+    (history / log_fn / the supervisor's stdout parse) instead of bare
+    prints, so every consumer sees ONE ordered stream; the TensorBoard
+    writer skips them (events carry strings, not scalars)."""
+    return {"event": name, "step": step, **fields}
+
+
 class DeferredMetrics:
     """One-interval-lag metric fetch: the non-blocking logging path.
 
@@ -45,6 +54,20 @@ class DeferredMetrics:
         pending, self._pending = self._pending, None
         if pending is not None:
             self._materialize(pending)
+
+    def discard(self) -> None:
+        """Drop the pending interval without emitting it — the rollback path
+        uses this: the pending metrics describe state that is about to be
+        rewound, and materializing them could re-trigger the very policy
+        that is unwinding."""
+        self._pending = None
+
+    def emit_event(self, record: dict) -> None:
+        """Emit a loop-status event (:func:`event_record`) through the same
+        ordered stream: the pending metric interval flushes first, so an
+        event at step N can never appear before the metrics of step < N."""
+        self.flush()
+        self._emit(record)
 
     def _materialize(self, item) -> None:
         step, metrics, extras = item

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections.abc import Callable
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +54,11 @@ class TrainState:
     ``dp`` axis (``parallel/zero.residual_shardings``). ``None`` — and absent
     from the pytree, so fp32 checkpoints are unchanged — when ``grad_comm``
     is fp32.
+
+    ``health`` carries the on-device health guard's anomaly counters
+    (``health.HealthState``; replicated scalars). Same None-when-disabled
+    contract as ``grad_residual``, so guarded and unguarded checkpoints
+    differ only when the guard is actually on.
     """
 
     step: jax.Array
@@ -62,6 +67,7 @@ class TrainState:
     model_state: Any
     rng: jax.Array
     grad_residual: Any = None
+    health: Any = None
 
 
 # ---------------------------------------------------------------------------
@@ -313,8 +319,18 @@ class Trainer:
         allow_idle_axes: bool = False,
         grad_comm: str = "fp32",
         grad_comm_block: int = 256,
+        health: Any = None,
+        fault_nan_step: int | None = None,
     ):
         self.model = model
+        # On-device health guard (health.py): a config.HealthConfig with
+        # enabled=True compiles anomaly detection + skip-update into every
+        # step body; anything else leaves the step untouched.
+        self.health = health if (health is not None and health.enabled) else None
+        # Deterministic on-device NaN fault injection
+        # (fault_injection=nan:K): poisons the gradients of the step whose
+        # pre-step counter equals K — the test/chaos hook for the guard.
+        self.fault_nan_step = fault_nan_step
         self.tx = tx
         self.task = task
         self.mesh = mesh
@@ -441,6 +457,11 @@ class Trainer:
                 lambda p: jnp.zeros((dp, *jnp.shape(p)), jnp.float32),
                 nn.meta.unbox(params),
             )
+        health_state = None
+        if self.health is not None:
+            from .health import init_health_state
+
+            health_state = init_health_state()
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -448,6 +469,7 @@ class Trainer:
             model_state=dict(variables),
             rng=s_rng,
             grad_residual=grad_residual,
+            health=health_state,
         )
 
     def setup(self, example_batch) -> None:
@@ -615,6 +637,21 @@ class Trainer:
             check_vma=False,
         )(grads, opt_state, params)
 
+    def _instrument_grads(self, grads, step, metrics):
+        """Shared post-gradient hook for every step body (plain / quantized
+        / pipeline): deterministic NaN fault injection, then the health
+        guard's grad-norm observable. Injection precedes the norm so the
+        guard detects exactly what the optimizer would have consumed."""
+        if self.fault_nan_step is not None:
+            bad = step == self.fault_nan_step
+            grads = jax.tree.map(
+                lambda g: jnp.where(bad, jnp.full(g.shape, jnp.nan, g.dtype), g),
+                grads,
+            )
+        if self.health is not None:
+            metrics = {**metrics, "grad_norm": optax.global_norm(grads)}
+        return grads, metrics
+
     def _check_accum_divides(self, batch) -> None:
         """Equal-sized microbatch groups are what makes mean-of-group-means
         equal the whole-batch mean — an uneven split would silently bias the
@@ -676,6 +713,9 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / self.grad_accum, grads)
             else:
                 loss, grads = one_group(state.params, batch)
+            grads, metrics = self._instrument_grads(
+                grads, state.step, {"loss": loss}
+            )
             updates_tx, new_opt_state = self._tx_update(
                 grads, state.opt_state, state.params
             )
@@ -685,7 +725,7 @@ class Trainer:
                 params=new_params,
                 opt_state=new_opt_state,
             )
-            return new_state, {"loss": loss}
+            return new_state, metrics
 
         return step_fn
 
@@ -763,6 +803,9 @@ class Trainer:
                 state.params, state.model_state, batch, rng,
                 state.grad_residual,
             )
+            # Post-sync: the norm/poison see the replicated global-mean
+            # grads, the same view the optimizer consumes.
+            grads, metrics = self._instrument_grads(grads, state.step, metrics)
             updates_tx, new_opt_state = self._tx_update(
                 grads, state.opt_state, state.params
             )
@@ -829,6 +872,7 @@ class Trainer:
                     self._loss_and_updates, has_aux=True
                 )(state.params, state.model_state, batch, rng, True)
 
+            grads, metrics = self._instrument_grads(grads, state.step, metrics)
             updates_tx, new_opt_state = self._tx_update(
                 grads, state.opt_state, state.params
             )
@@ -853,12 +897,20 @@ class Trainer:
         if getattr(self.model, "schedule", None) == "1f1b_interleaved" and (
             getattr(self.model, "pipeline", True)
         ):
-            return self._pipeline_step_fn(), True
-        if self.grad_comm != "fp32":
+            fn, meshed = self._pipeline_step_fn(), True
+        elif self.grad_comm != "fp32":
             # Manual-mode body (shard_map): ``sharding.constrain`` must stay
             # a no-op, so no MeshedJit (see _quantized_dp_step_fn).
-            return self._quantized_dp_step_fn(), False
-        return self._plain_step_fn(), True
+            fn, meshed = self._quantized_dp_step_fn(), False
+        else:
+            fn, meshed = self._plain_step_fn(), True
+        if self.health is not None:
+            # Wrapping HERE — before the fused lax.scan — gives the
+            # single-step and K-fused programs identical guard semantics.
+            from .health import guard_step
+
+            fn = guard_step(fn, self.health)
+        return fn, meshed
 
     def _jit_step(self, fn, batch_shardings, meshed: bool):
         donate = (0,) if self._donate else ()
@@ -938,14 +990,59 @@ class Trainer:
         return self._eval_step
 
 
-def parse_fault_injection(spec: str) -> int | None:
-    """'step:K' -> K; '' -> None."""
+FAULT_KINDS = ("step", "nan", "hang", "corrupt")
+
+
+class FaultSpec(NamedTuple):
+    """One injected fault (docs/FAULT_TOLERANCE.md): ``kind`` is how the run
+    breaks, ``step`` is the pre-step counter value it breaks at.
+
+    - ``step``: hard-kill the process (os._exit — a crash, no cleanup);
+    - ``nan``: poison that step's gradients on device (Trainer hook);
+    - ``hang``: stall the host loop forever (heartbeat goes stale);
+    - ``corrupt``: truncate the latest checkpoint, then hard-kill.
+    """
+
+    kind: str
+    step: int
+
+
+def parse_fault_injection(spec: str) -> FaultSpec | None:
+    """'kind:K' -> FaultSpec(kind, K) for kind in FAULT_KINDS; '' -> None."""
     if not spec:
         return None
     kind, _, arg = spec.partition(":")
-    if kind != "step" or not arg.isdigit():
-        raise ValueError(f"fault_injection {spec!r}: expected 'step:K'")
-    return int(arg)
+    if kind not in FAULT_KINDS or not arg.isdigit():
+        raise ValueError(
+            f"fault_injection {spec!r}: expected one of "
+            f"{'|'.join(FAULT_KINDS)}:K"
+        )
+    return FaultSpec(kind, int(arg))
+
+
+class Preempted(Exception):
+    """Raised by :func:`fit` after a SIGTERM/SIGINT-triggered final save:
+    the state at ``step`` is durable; the process should exit
+    ``supervisor.EXIT_PREEMPTED`` without restarting."""
+
+    def __init__(self, step: int, saved: bool):
+        super().__init__(f"preempted at step {step} (saved={saved})")
+        self.step = step
+        self.saved = saved
+
+
+class HealthRollback(Exception):
+    """Raised by :func:`fit` when the health guard reports
+    ``max_consecutive_anomalies`` anomalous steps in a row: the in-memory
+    state is not worth continuing from — the caller (``cli.cmd_train``)
+    restores the last durable checkpoint and re-enters training."""
+
+    def __init__(self, step: int, consecutive: int):
+        super().__init__(
+            f"{consecutive} consecutive anomalous steps at step {step}"
+        )
+        self.step = step
+        self.consecutive = consecutive
 
 
 def evaluate(trainer: Trainer, state: TrainState, batches) -> dict[str, float]:
@@ -988,7 +1085,7 @@ def check_fusion_cadences(
     log_every: int = 0,
     eval_every: int = 0,
     save_every: int = 0,
-    fault_step: int | None = None,
+    fault: FaultSpec | None = None,
 ) -> None:
     """Composition fences for fused multi-step dispatch: every host-side
     boundary (log/eval/save/fault/resume) must land on a fused-call edge,
@@ -998,6 +1095,10 @@ def check_fusion_cadences(
     k = steps_per_call
     if k < 1:
         raise ValueError(f"steps_per_call={k} must be >= 1")
+    if fault is not None and fault.kind not in FAULT_KINDS:
+        raise ValueError(
+            f"fault kind {fault.kind!r} not in {FAULT_KINDS}"
+        )
     if k == 1:
         return
     for name, every in (
@@ -1012,11 +1113,14 @@ def check_fusion_cadences(
                 f"advance {k} steps at a time, so every cadence boundary has "
                 "to land on a call edge"
             )
-    if fault_step is not None and fault_step % k:
+    # nan:K is exempt: it fires ON DEVICE (the step body tests the carried
+    # step counter), so it lands mid-scan just fine. The host-side kinds
+    # (step/hang/corrupt) only get control at call edges.
+    if fault is not None and fault.kind != "nan" and fault.step % k:
         raise ValueError(
-            f"steps_per_call={k} must divide fault_step={fault_step}: the "
-            "injected kill fires between fused calls — use steps_per_call=1 "
-            "for mid-interval fault injection"
+            f"steps_per_call={k} must divide fault_step={fault.step} "
+            f"(kind={fault.kind!r}): host-side fault injections fire between "
+            "fused calls — use steps_per_call=1 for mid-interval faults"
         )
     if start % k:
         raise ValueError(
@@ -1038,9 +1142,11 @@ def fit(
     profiler=None,
     ckpt=None,
     save_every: int = 0,
-    fault_step: int | None = None,
+    fault: FaultSpec | None = None,
     eval_every: int = 0,
     eval_fn=None,
+    health=None,
+    heartbeat_file: str | None = None,
 ) -> tuple[TrainState, list[dict]]:
     """Host step loop.
 
@@ -1050,27 +1156,49 @@ def fit(
     D2H copy and emits the PREVIOUS boundary's already-arrived values — one
     interval of lag, zero dispatch-queue drains for observability (the
     final interval flushes before return, so history is always complete).
-    Checkpoint saves are async and off the loop. ``fault_step`` hard-kills
-    the process (no cleanup, simulating a crash) before running that step —
-    the test hook for the restart-based recovery flow (SURVEY §5): relaunch
-    resumes from the last durable orbax checkpoint.
+    Checkpoint saves are async and off the loop. Loop-status events (fault
+    injections, preemption saves, rollbacks) flow through the SAME emit
+    path as metric lines (``metrics.event_record``), so history, log_fn and
+    the supervisor's stdout parse all see one ordered stream.
 
     ``steps_per_call`` = K > 1 fuses K steps into one on-device scan
     (:meth:`Trainer.fused_train_step`): ``batches`` must then yield stacked
     super-batches (leaves ``[K, B, ...]`` — ``data.sharded_superbatches``),
     and K must divide ``steps`` and every log/eval/save/fault cadence
-    (:func:`check_fusion_cadences`). K=1 is bit-identical to the unfused
-    loop — it IS the unfused loop.
+    (:func:`check_fusion_cadences`; on-device ``nan:K`` is exempt). K=1 is
+    bit-identical to the unfused loop — it IS the unfused loop.
 
     ``eval_every`` > 0 runs :func:`evaluate` over ``eval_fn()`` (a callable
     returning a fresh iterable of sharded eval batches) every that many
     steps and after the final step; eval metrics join the history/TB stream
     prefixed ``eval_``.
+
+    Resilience (docs/FAULT_TOLERANCE.md):
+
+    - ``fault`` injects one deterministic failure (:class:`FaultSpec`):
+      ``step``/``corrupt`` hard-kill via ``os._exit(EXIT_FAULT)`` (crash
+      semantics — no atexit, no async-save drain; ``corrupt`` first
+      truncates the latest checkpoint), ``hang`` stalls the loop forever,
+      ``nan`` is compiled into the step body (Trainer ``fault_nan_step``).
+    - SIGTERM/SIGINT (preemption) is converted into a final SYNCHRONOUS
+      ``ckpt.save(force=True) + wait()`` at the next call edge, then
+      :class:`Preempted` — resume loses zero durable steps.
+    - The loop touches ``heartbeat_file`` (default: ``$DDL_HEARTBEAT_FILE``,
+      exported by the supervisor) at loop and log boundaries; the log-
+      boundary touch follows a real D2H sync, so a hung device stops the
+      heartbeat within one logging interval.
+    - ``health`` (a ``config.HealthConfig``): when the logged metric stream
+      reports ``max_consecutive_anomalies`` consecutive anomalous steps
+      (detection lags one logging interval — the deferred-fetch contract),
+      raises :class:`HealthRollback` for the caller's restore-and-retry.
     """
     import os
+    import signal
     import sys
 
-    from .metrics import DeferredMetrics
+    from .metrics import DeferredMetrics, event_record
+    from .supervisor import EXIT_FAULT, HEARTBEAT_ENV
+    from .supervisor import touch as hb_touch
 
     if eval_every and eval_fn is None:
         raise ValueError("eval_every > 0 requires eval_fn")
@@ -1078,17 +1206,31 @@ def fit(
     start = int(state.step)
     check_fusion_cadences(
         k, steps=steps, start=start, log_every=log_every,
-        eval_every=eval_every, save_every=save_every, fault_step=fault_step,
+        eval_every=eval_every, save_every=save_every, fault=fault,
     )
+    if fault is not None and fault.kind == "corrupt" and ckpt is None:
+        raise ValueError("fault_injection=corrupt:K requires a checkpoint_dir")
     step_call = trainer.train_step if k == 1 else trainer.fused_train_step(k)
+
+    hb = (
+        heartbeat_file if heartbeat_file is not None
+        else os.environ.get(HEARTBEAT_ENV)
+    )
+    max_consec = (
+        health.max_consecutive_anomalies if health is not None else 0
+    )
 
     history = []
 
     def emit(m):
         history.append(m)
         log_fn(m)
-        if writer is not None:
+        if writer is not None and "event" not in m:
             writer.write(m["step"], {x: v for x, v in m.items() if x != "step"})
+        if max_consec and m.get("consecutive_anomalies", 0) >= max_consec:
+            raise HealthRollback(
+                int(m.get("step", 0)), int(m["consecutive_anomalies"])
+            )
 
     deferred = DeferredMetrics(emit)
 
@@ -1100,49 +1242,112 @@ def fit(
         m["step"] = end
         emit(m)
 
+    preempt = {"signum": None}
+
+    def _on_preempt(signum, frame):
+        preempt["signum"] = signum
+
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[sig] = signal.signal(sig, _on_preempt)
+        except ValueError:
+            pass  # not the main thread (a test harness driving fit)
+
     t0 = time.perf_counter()
     it = iter(batches)
     end = start
-    for i in range(start, steps, k):
-        if fault_step is not None and i == fault_step:
-            deferred.flush()  # the previous interval's line survives the kill
-            print(f"fault injection: killing process before step {i}")
-            sys.stdout.flush()
-            os._exit(17)  # crash semantics: no atexit, no async-save drain
-        try:
-            batch = next(it)
-        except StopIteration:
-            break
-        state, metrics = step_call(state, batch)
-        end = i + k
+    hb_touch(hb)
+    try:
+        for i in range(start, steps, k):
+            if preempt["signum"] is not None:
+                # Preemption-safe save: synchronous, force (off-cadence
+                # steps must still save), before the exception — by the
+                # time Preempted propagates, the state IS durable.
+                saved = False
+                if ckpt is not None:
+                    if ckpt.latest_step() != end:
+                        ckpt.save(end, state, {"next_index": end}, force=True)
+                    ckpt.wait()
+                    saved = True
+                deferred.emit_event(event_record(
+                    "preempt_save", end, saved=saved,
+                    signum=int(preempt["signum"]),
+                ))
+                sys.stdout.flush()
+                raise Preempted(end, saved)
+            if fault is not None and i == fault.step and fault.kind != "nan":
+                if fault.kind == "step":
+                    deferred.emit_event(event_record("fault_kill", i))
+                    sys.stdout.flush()
+                    os._exit(EXIT_FAULT)
+                if fault.kind == "hang":
+                    deferred.emit_event(event_record("fault_hang", i))
+                    sys.stdout.flush()
+                    while True:  # heartbeat stale -> supervisor SIGKILLs
+                        time.sleep(3600)
+                if fault.kind == "corrupt":
+                    ckpt.wait()  # corrupt a FINALIZED latest, not a temp dir
+                    bad = ckpt.corrupt_latest_for_test()
+                    deferred.emit_event(event_record(
+                        "fault_corrupt", i, corrupted_step=bad
+                    ))
+                    sys.stdout.flush()
+                    os._exit(EXIT_FAULT)
+            hb_touch(hb)
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            state, metrics = step_call(state, batch)
+            end = i + k
+            if profiler is not None:
+                # Per-step granularity for the window bounds; under fusion
+                # the trace start/stop still only take effect at call edges.
+                for j in range(i, end):
+                    profiler.step(j)
+            if log_every and end % log_every == 0:
+                # Fused metrics come back stacked [K]; the logged step is
+                # the interval's last, same as the unfused loop.
+                last = (
+                    metrics if k == 1
+                    else jax.tree.map(lambda v: v[-1], metrics)
+                )
+                deferred.push(
+                    end, last, wall_s=round(time.perf_counter() - t0, 3)
+                )
+                # push materialized the PREVIOUS interval — a real D2H sync
+                # — so this touch is the honest device-liveness signal.
+                hb_touch(hb)
+            if eval_every and end % eval_every == 0:
+                run_eval(end)
+                hb_touch(hb)
+            if ckpt is not None and save_every and end % save_every == 0:
+                ckpt.save(end, state, {"next_index": end})
+                if fault is not None:
+                    # Fault injection simulates a crash at an arbitrary
+                    # step; the recovery contract is "resume from the last
+                    # DURABLE save". Draining here makes every completed
+                    # save durable, so crash→resume is deterministic
+                    # instead of racing the async writer (ADVICE.md r1).
+                    ckpt.wait()
+        if eval_every and end % eval_every != 0 and end > start:
+            run_eval(end)  # final eval so short runs still report one
+        deferred.flush()
+    except HealthRollback as rb:
+        # The pending interval describes state that is being rewound;
+        # materializing it could re-trigger the policy mid-unwind.
+        deferred.discard()
+        emit(event_record(
+            "health_rollback", rb.step, consecutive=rb.consecutive
+        ))
+        sys.stdout.flush()
+        raise
+    finally:
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
         if profiler is not None:
-            # Per-step granularity for the window bounds; under fusion the
-            # trace start/stop still only take effect at call edges.
-            for j in range(i, end):
-                profiler.step(j)
-        if log_every and end % log_every == 0:
-            # Fused metrics come back stacked [K]; the logged step is the
-            # interval's last, same as the unfused loop.
-            last = metrics if k == 1 else jax.tree.map(lambda v: v[-1], metrics)
-            deferred.push(
-                end, last, wall_s=round(time.perf_counter() - t0, 3)
-            )
-        if eval_every and end % eval_every == 0:
-            run_eval(end)
-        if ckpt is not None and save_every and end % save_every == 0:
-            ckpt.save(end, state, {"next_index": end})
-            if fault_step is not None:
-                # Fault injection simulates a crash at an arbitrary step; the
-                # recovery contract is "resume from the last DURABLE save".
-                # Draining here makes every completed save durable, so the
-                # crash→resume test is deterministic instead of racing the
-                # async writer (ADVICE.md r1).
-                ckpt.wait()
-    if eval_every and end % eval_every != 0 and end > start:
-        run_eval(end)  # final eval so short runs still report one
-    deferred.flush()
-    if profiler is not None:
-        profiler.close()
-    if writer is not None:
-        writer.flush()
+            profiler.close()
+        if writer is not None:
+            writer.flush()
     return state, history

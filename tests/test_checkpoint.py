@@ -60,6 +60,46 @@ def test_save_restore_resume_parity(tmp_path):
     np.testing.assert_allclose(losses_full, losses_a + losses_b, rtol=1e-5)
 
 
+def test_restore_falls_back_past_corrupt_latest(tmp_path, capsys):
+    """A truncated latest checkpoint must not kill resume: restore() walks
+    back to the newest EARLIER durable step (losing save_every steps, not
+    the run). One shared save/corrupt setup also covers the two failure
+    modes: explicit-step requests never substitute, and restore() raises
+    only when NO step is restorable."""
+    import pytest
+
+    mesh = single_device_mesh()
+    ds = dataset()
+    tr = make_trainer(mesh)
+    state = tr.init(0, ds.batch(0))
+    with CheckpointManager(str(tmp_path / "c")) as ckpt:
+        state, _ = train_steps(tr, state, ds, mesh, 0, 2)
+        assert ckpt.save(2, state, {"next_index": 2}, force=True)
+        state, _ = train_steps(tr, state, ds, mesh, 2, 4)
+        assert ckpt.save(4, state, {"next_index": 4}, force=True)
+        ckpt.wait()
+        assert ckpt.corrupt_latest_for_test() == 4
+
+    tr2 = make_trainer(mesh)
+    tr2.init(9, ds.batch(0))
+    abstract = tr2.abstract_state_with_shardings()
+    with CheckpointManager(str(tmp_path / "c")) as ckpt2:
+        s2, data_state = ckpt2.restore(abstract)
+        assert int(s2.step) == 2
+        assert data_state["next_index"] == 2
+        assert "falling back" in capsys.readouterr().err
+
+        # An EXPLICIT step request must not silently substitute another step.
+        with pytest.raises(Exception):
+            ckpt2.restore(abstract, step=4)
+
+        # Corrupt the surviving step too: with nothing restorable left the
+        # fallback walk must fail loudly, not return garbage.
+        assert ckpt2.corrupt_latest_for_test(step=2) == 2
+        with pytest.raises(RuntimeError, match="no restorable checkpoint"):
+            ckpt2.restore(abstract)
+
+
 def test_cross_mesh_restore(tmp_path):
     # Save under dp=1, restore under dp=8 (sharding-aware restore into the
     # live mesh — the TPU version of "load on rank0 + NCCL broadcast").

@@ -15,16 +15,21 @@ import sys
 import numpy as np
 import pytest
 
-from distributeddeeplearning_tpu.train import parse_fault_injection
+from distributeddeeplearning_tpu.train import FaultSpec, parse_fault_injection
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_parse_fault_injection():
     assert parse_fault_injection("") is None
-    assert parse_fault_injection("step:5") == 5
+    assert parse_fault_injection("step:5") == FaultSpec("step", 5)
+    assert parse_fault_injection("nan:3") == FaultSpec("nan", 3)
+    assert parse_fault_injection("hang:7") == FaultSpec("hang", 7)
+    assert parse_fault_injection("corrupt:6") == FaultSpec("corrupt", 6)
     with pytest.raises(ValueError):
         parse_fault_injection("epoch:2")
+    with pytest.raises(ValueError):
+        parse_fault_injection("nan:x")
 
 
 def _train_cmd(tmp_path, extra):
@@ -50,7 +55,9 @@ def test_crash_and_resume(tmp_path):
         capture_output=True, text=True, env=env, cwd=REPO, timeout=540,
     )
     assert crashed.returncode == 17, crashed.stderr[-2000:]
-    assert "fault injection: killing process before step 5" in crashed.stdout
+    # The kill is announced through the metrics event stream, not a bare
+    # print: one ordered stdout for supervisors to parse.
+    assert '"event": "fault_kill"' in crashed.stdout
     # Steps 1..5 ran; a durable checkpoint exists at step 2 or 4.
     resumed = subprocess.run(
         _train_cmd(tmp_path, []),
@@ -126,6 +133,140 @@ def test_crash_and_resume_file_backed(tmp_path):
     assert set(got) == {5, 6, 7, 8}  # resumed at step 4, trained 5..8
     for step, loss in got.items():
         np.testing.assert_allclose(loss, want[step], rtol=1e-5, err_msg=str(step))
+
+
+def _supervise_cmd(tmp_path, extra):
+    """The _train_cmd run under ``cli supervise`` with fast-test supervisor
+    knobs (tiny backoff, tight poll)."""
+    cmd = _train_cmd(tmp_path, [
+        "--override", f"train.compile_cache_dir={tmp_path}/xla",
+        "--override", "supervisor.backoff_base_s=0.1",
+        "--override", "supervisor.poll_interval_s=0.1",
+        *extra,
+    ])
+    cmd[cmd.index("train")] = "supervise"
+    return cmd
+
+
+@pytest.mark.slow
+def test_supervised_corrupt_recovery(tmp_path):
+    """corrupt:6 truncates the latest durable checkpoint and crashes; the
+    supervisor restarts, and the resume path falls back to the newest
+    EARLIER durable step — the run still reaches the final step unattended."""
+    run = subprocess.run(
+        _supervise_cmd(tmp_path, [
+            "--override", "train.fault_injection=corrupt:6",
+        ]),
+        capture_output=True, text=True, env=dict(os.environ), cwd=REPO,
+        timeout=540,
+    )
+    assert run.returncode == 0, run.stderr[-3000:]
+    assert '"event": "fault_corrupt"' in run.stdout
+    assert '"event": "supervisor_restart"' in run.stdout
+    assert '"event": "fault_disarmed"' in run.stdout  # attempt 1 never re-fires
+    assert "falling back" in run.stderr  # checkpoint.restore fallback fired
+    assert '"step": 8' in run.stdout  # trained through to the end
+
+
+@pytest.mark.slow
+def test_supervised_hang_recovery(tmp_path):
+    """hang:7 stalls the step loop; the heartbeat goes stale, the supervisor
+    SIGKILLs and restarts, and the resumed attempt finishes the run."""
+    run = subprocess.run(
+        _supervise_cmd(tmp_path, [
+            "--override", "train.fault_injection=hang:7",
+            # Must exceed the first attempt's cold compile (the loop can't
+            # touch the heartbeat while jit blocks the host).
+            "--override", "supervisor.hang_timeout_s=120",
+        ]),
+        capture_output=True, text=True, env=dict(os.environ), cwd=REPO,
+        timeout=540,
+    )
+    assert run.returncode == 0, run.stderr[-3000:]
+    assert '"event": "fault_hang"' in run.stdout
+    assert '"event": "supervisor_hang_kill"' in run.stdout
+    assert '"step": 8' in run.stdout
+
+
+@pytest.mark.slow
+def test_supervised_nan_skip(tmp_path):
+    """nan:5 poisons one step's gradients ON DEVICE; the health guard skips
+    that update in-place — no crash, no restart, run completes with exactly
+    one recorded anomaly."""
+    run = subprocess.run(
+        _supervise_cmd(tmp_path, [
+            "--override", "train.fault_injection=nan:5",
+            "--override", "health.enabled=True",
+        ]),
+        capture_output=True, text=True, env=dict(os.environ), cwd=REPO,
+        timeout=540,
+    )
+    assert run.returncode == 0, run.stderr[-3000:]
+    assert '"skipped": 1.0' in run.stdout  # the poisoned step was skipped
+    assert '"event": "supervisor_restart"' not in run.stdout
+    assert '"step": 8' in run.stdout
+    # Post-fault losses stay finite: the skip really protected the params.
+    import json as json_lib
+
+    losses = [
+        json_lib.loads(line)["loss"]
+        for line in run.stdout.splitlines()
+        if line.startswith("{") and '"loss"' in line
+    ]
+    assert len(losses) == 8 and all(np.isfinite(losses))
+
+
+@pytest.mark.slow
+def test_sigterm_preemption_save_and_resume(tmp_path):
+    """SIGTERM mid-run force-saves synchronously (off the save cadence),
+    exits EXIT_PREEMPTED, and a relaunch resumes from exactly the preempted
+    step — zero durable steps lost."""
+    import json as json_lib
+    import signal as signal_lib
+
+    from distributeddeeplearning_tpu.supervisor import EXIT_PREEMPTED
+
+    env = dict(os.environ)
+    err_path = tmp_path / "preempt.err"
+    with open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            _train_cmd(tmp_path, [
+                "--override", "train.steps=2000",
+                "--override", "train.save_every=500",
+                "--override", f"train.compile_cache_dir={tmp_path}/xla",
+            ]),
+            stdout=subprocess.PIPE, stderr=err_f, text=True, env=env,
+            cwd=REPO,
+        )
+        try:
+            for line in proc.stdout:  # wait until training actually steps
+                if '"loss"' in line:
+                    break
+            else:
+                pytest.fail(f"no training line: {err_path.read_text()[-3000:]}")
+            proc.send_signal(signal_lib.SIGTERM)
+            rest, _ = proc.communicate(timeout=300)
+        finally:
+            proc.kill()
+    assert proc.returncode == EXIT_PREEMPTED, err_path.read_text()[-3000:]
+    ev = next(
+        json_lib.loads(line) for line in rest.splitlines()
+        if '"event": "preempt_save"' in line
+    )
+    assert ev["saved"] is True
+    n = ev["step"]
+    assert n >= 1 and n % 500 != 0  # off-cadence: the FORCE save path
+
+    resumed = subprocess.run(
+        _train_cmd(tmp_path, [
+            "--override", f"train.steps={n + 2}",
+            "--override", f"train.compile_cache_dir={tmp_path}/xla",
+        ]),
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=540,
+    )
+    assert resumed.returncode == 0, resumed.stderr[-3000:]
+    assert f"resumed from step {n}" in resumed.stdout
+    assert f'"step": {n + 2}' in resumed.stdout
 
 
 def _free_port() -> int:

@@ -19,6 +19,7 @@ from distributeddeeplearning_tpu import data as data_lib
 from distributeddeeplearning_tpu import models
 from distributeddeeplearning_tpu.metrics import DeferredMetrics
 from distributeddeeplearning_tpu.train import (
+    FaultSpec,
     Trainer,
     check_fusion_cadences,
     fit,
@@ -176,7 +177,10 @@ def test_fit_runs_fused_and_history_is_complete():
         (dict(steps=8, log_every=3), "divide log_every=3"),
         (dict(steps=8, eval_every=5), "divide eval_every=5"),
         (dict(steps=8, save_every=5), "divide save_every=5"),
-        (dict(steps=8, fault_step=3), "divide fault_step=3"),
+        (dict(steps=8, fault=FaultSpec("step", 3)), "divide fault_step=3"),
+        (dict(steps=8, fault=FaultSpec("hang", 3)), "divide fault_step=3"),
+        (dict(steps=8, fault=FaultSpec("corrupt", 3)), "divide fault_step=3"),
+        (dict(steps=8, fault=FaultSpec("bogus", 2)), "not in"),
         (dict(steps=8, start=3), "resume step 3"),
     ],
 )
@@ -188,6 +192,15 @@ def test_fusion_cadence_fences(kwargs, match):
 def test_fusion_cadence_fence_k0():
     with pytest.raises(ValueError, match="steps_per_call=0"):
         check_fusion_cadences(0, steps=8)
+
+
+def test_fusion_cadence_nan_fault_exempt():
+    # nan:K is compiled INTO the step body (it fires mid-scan on device), so
+    # it composes with any fused cadence — unlike the host-side kinds.
+    check_fusion_cadences(2, steps=8, fault=FaultSpec("nan", 3))
+    # Kind validation still applies at k=1 (the unfused loop).
+    with pytest.raises(ValueError, match="not in"):
+        check_fusion_cadences(1, steps=8, fault=FaultSpec("bogus", 3))
 
 
 def test_fit_rejects_bad_cadence_before_stepping():

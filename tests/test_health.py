@@ -1,0 +1,287 @@
+"""On-device health guard (health.py) + fit()'s resilience hooks.
+
+The load-bearing contract is SKIP-UPDATE PARITY: an anomalous step must
+leave params/opt_state bit-identical to never having run it. The oracle
+runs the SAME compiled guarded step function and simply skips the faulted
+step on the host — same program, same inputs on every healthy step, so the
+comparison is exact equality, not a tolerance.
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu import data as data_lib
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.config import HealthConfig
+from distributeddeeplearning_tpu.health import guard_step, init_health_state
+from distributeddeeplearning_tpu.train import (
+    HealthRollback,
+    Preempted,
+    TrainState,
+    Trainer,
+    fit,
+    get_task,
+    make_optimizer,
+)
+
+from helpers import mesh_of
+
+
+def _trainer(mesh, **kw):
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=256, max_len=64, dropout_rate=0.0
+    )
+    kw.setdefault("health", HealthConfig(enabled=True))
+    return Trainer(
+        model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh,
+        donate=False, **kw
+    )
+
+
+_SHARED: dict = {}
+
+
+def _shared_trainer():
+    """ONE guarded trainer (nan fault at step 2) reused by every end-to-end
+    test in this file — each fresh Trainer costs a full jit compile, and the
+    guard/fault semantics under test don't depend on which instance runs."""
+    if not _SHARED:
+        mesh = mesh_of(dp=4)
+        _SHARED["mesh"] = mesh
+        _SHARED["trainer"] = _trainer(mesh, fault_nan_step=2)
+    return _SHARED["mesh"], _SHARED["trainer"]
+
+
+def _ds():
+    return data_lib.SyntheticTokens(
+        batch_size=16, seq_len=32, vocab_size=256, seed=0, n_distinct=4
+    )
+
+
+def _batches(mesh, n, k=1):
+    ds = _ds()
+    it = (
+        data_lib.sharded_batches(ds.iter_from(0), mesh) if k == 1
+        else data_lib.sharded_superbatches(ds.iter_from(0), mesh, k)
+    )
+    out = []
+    for i, b in enumerate(it):
+        if i >= n:
+            break
+        out.append(b)
+    return out
+
+
+def _assert_trees_equal(a, b, what):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=what)
+
+
+def test_skip_update_parity_bitwise():
+    """nan:2 under the guard == manually not running step 2: params AND
+    opt_state bit-identical 3 steps later."""
+    mesh, trainer = _shared_trainer()
+    batches = _batches(mesh, 5)
+
+    faulted = trainer.init(0, _ds().batch(0))
+    for b in batches:
+        faulted, m = trainer.train_step(faulted, b)
+
+    oracle = trainer.init(0, _ds().batch(0))
+    for i, b in enumerate(batches):
+        if i == 2:
+            # Skip the step entirely but keep the clocks aligned: the step
+            # counter advances (per-step RNG + data cursor semantics) and
+            # the batch is consumed.
+            oracle = oracle.replace(step=oracle.step + 1)
+            continue
+        oracle, _ = trainer.train_step(oracle, b)
+
+    assert int(faulted.step) == int(oracle.step) == 5
+    _assert_trees_equal(faulted.params, oracle.params, "params")
+    _assert_trees_equal(faulted.opt_state, oracle.opt_state, "opt_state")
+    assert int(faulted.health.anomaly_count) == 1
+    assert int(faulted.health.consecutive) == 0  # healthy steps reset it
+    assert int(oracle.health.anomaly_count) == 0
+
+
+def test_guard_parity_under_fused_dispatch():
+    """steps_per_call=2 with the fault INSIDE a fused call (step 2 = scan
+    index 0 of call 2): the guard is wrapped before the scan, so K=2
+    matches the unfused guarded run."""
+    mesh, trainer = _shared_trainer()
+    s1 = trainer.init(0, _ds().batch(0))
+    for b in _batches(mesh, 4):
+        s1, _ = trainer.train_step(s1, b)
+
+    s2 = trainer.init(0, _ds().batch(0))
+    fused = trainer.fused_train_step(2)
+    stacked_metrics = []
+    for sb in _batches(mesh, 2, k=2):
+        s2, m = fused(s2, sb)
+        stacked_metrics.append(m)
+
+    assert int(s2.step) == 4
+    assert int(s1.health.anomaly_count) == int(s2.health.anomaly_count) == 1
+    # Fused metrics come back stacked [K]: the skip is visible mid-call.
+    np.testing.assert_array_equal(
+        np.asarray(stacked_metrics[1]["skipped"]), [1, 0]
+    )
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+        )
+    assert all(
+        np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(s2.params)
+    )
+
+
+def _unit_state():
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params={"w": jnp.zeros((), jnp.float32)},
+        opt_state=(),
+        model_state={},
+        rng=jax.random.PRNGKey(0),
+        health=init_health_state(),
+    )
+
+
+def _counting_step(state, batch):
+    return (
+        state.replace(
+            step=state.step + 1,
+            params={"w": state.params["w"] + 1.0},
+        ),
+        {"loss": jnp.asarray(batch["loss"], jnp.float32)},
+    )
+
+
+def test_guard_nonfinite_loss_skips():
+    g = guard_step(_counting_step, HealthConfig(enabled=True))
+    state = _unit_state()
+    skipped = []
+    for loss in [1.0, float("nan"), float("inf"), 1.0]:
+        state, m = g(state, {"loss": loss})
+        skipped.append(int(m["skipped"]))
+    assert skipped == [0, 1, 1, 0]
+    assert float(state.params["w"]) == 2.0  # two updates survived
+    assert int(state.step) == 4  # the clock never stalls
+    assert int(state.health.anomaly_count) == 2
+    # The nan never reached the EMA (it would poison it forever).
+    assert np.isfinite(float(state.health.loss_ema))
+    assert int(state.health.ema_steps) == 2
+
+
+def test_guard_consecutive_counter_runs_and_resets():
+    g = guard_step(_counting_step, HealthConfig(enabled=True))
+    state = _unit_state()
+    consec = []
+    for loss in [1.0, float("nan"), float("nan"), float("nan"), 1.0]:
+        state, m = g(state, {"loss": loss})
+        consec.append(int(m["consecutive_anomalies"]))
+    assert consec == [0, 1, 2, 3, 0]
+
+
+def test_guard_ema_spike_detection():
+    cfg = HealthConfig(
+        enabled=True, ema_beta=0.5, spike_factor=2.0, ema_warmup_steps=2
+    )
+    g = guard_step(_counting_step, cfg)
+    state = _unit_state()
+    skipped = []
+    # Two warmup steps (detector disarmed), then a finite 10x spike.
+    for loss in [1.0, 1.0, 1.0, 10.0, 1.0]:
+        state, m = g(state, {"loss": loss})
+        skipped.append(int(m["skipped"]))
+    assert skipped == [0, 0, 0, 1, 0]
+    assert float(state.params["w"]) == 4.0
+    assert int(state.health.anomaly_count) == 1
+
+
+def test_guard_spike_disarmed_during_warmup():
+    cfg = HealthConfig(
+        enabled=True, ema_beta=0.5, spike_factor=2.0, ema_warmup_steps=10
+    )
+    g = guard_step(_counting_step, cfg)
+    state = _unit_state()
+    # The same 10x jump inside the warmup window must NOT be an anomaly —
+    # early-training losses legitimately move this much.
+    for loss in [1.0, 10.0, 1.0]:
+        state, m = g(state, {"loss": loss})
+    assert int(state.health.anomaly_count) == 0
+
+
+def test_fit_raises_health_rollback():
+    """fit() turns a sustained anomaly streak (via the LOGGED metric stream
+    — one interval of deferred lag) into HealthRollback, after emitting a
+    health_rollback event through the same stream."""
+    # The threshold is a host-side policy knob consumed by fit() directly —
+    # the compiled guard is unchanged, so the shared trainer serves here too.
+    mesh, trainer = _shared_trainer()
+    health = HealthConfig(enabled=True, max_consecutive_anomalies=1)
+    state = trainer.init(0, _ds().batch(0))
+    lines = []
+    with pytest.raises(HealthRollback) as ei:
+        fit(
+            trainer, state,
+            data_lib.sharded_batches(_ds().iter_from(0), mesh),
+            steps=8, log_every=1, log_fn=lines.append, health=health,
+        )
+    assert ei.value.step == 3  # the interval that reported the streak
+    assert ei.value.consecutive == 1
+    assert lines[-1]["event"] == "health_rollback"
+
+
+def test_fit_preemption_raises_after_save(tmp_path):
+    """A SIGTERM mid-loop becomes Preempted at the next call edge; with a
+    checkpoint manager attached the state is durably force-saved FIRST."""
+    from distributeddeeplearning_tpu.checkpoint import CheckpointManager
+
+    # Shared trainer again: its nan fault at step 2 is silently skipped by
+    # the guard and is irrelevant to the preemption path under test.
+    mesh, trainer = _shared_trainer()
+    state = trainer.init(0, _ds().batch(0))
+    lines = []
+
+    def log_and_preempt(m):
+        lines.append(m)
+        if m.get("step") == 2 and "event" not in m:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with CheckpointManager(str(tmp_path / "ckpt")) as ckpt:
+        with pytest.raises(Preempted) as ei:
+            fit(
+                trainer, state,
+                data_lib.sharded_batches(_ds().iter_from(0), mesh),
+                steps=50, log_every=1, log_fn=log_and_preempt,
+                ckpt=ckpt, save_every=0,  # force-save is the ONLY save path
+            )
+        assert ei.value.saved is True
+        step = ei.value.step
+        assert ckpt.latest_step() == step  # durable, off-cadence
+    events = [m for m in lines if m.get("event") == "preempt_save"]
+    assert len(events) == 1 and events[0]["saved"] is True
+    # fit restored the previous SIGTERM disposition on the way out.
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_trainstate_schema_unchanged_when_guard_off():
+    """health=None stays ABSENT from the pytree — unguarded checkpoints and
+    donation buffers are byte-compatible with pre-guard ones."""
+    mesh = mesh_of(dp=4)
+    trainer = _trainer(mesh, health=None)
+    state = trainer.init(0, _ds().batch(0))
+    assert state.health is None
+    assert not any(
+        "health" in jax.tree_util.keystr(p)
+        for p, _ in jax.tree_util.tree_leaves_with_path(state)
+    )

@@ -1,0 +1,294 @@
+"""Supervisor state machine (supervisor.py) — pure unit tests.
+
+Every source of nondeterminism is injected (clock, sleep, popen, jitter,
+heartbeat mtime), so backoff growth, hang detection, give-up and preemption
+are exercised with ZERO subprocesses and ZERO wall time. The real-subprocess
+integration lives in tests/test_fault_tolerance.py (slow lane).
+"""
+
+import random
+
+import pytest
+
+from distributeddeeplearning_tpu.config import SupervisorConfig
+from distributeddeeplearning_tpu.supervisor import (
+    CLEAN,
+    CRASH,
+    EXIT_FAULT,
+    EXIT_PREEMPTED,
+    FAULT,
+    HANG,
+    PREEMPTED,
+    Supervisor,
+    classify_exit,
+)
+
+
+def test_classify_exit():
+    assert classify_exit(0) == CLEAN
+    assert classify_exit(EXIT_FAULT) == FAULT
+    assert classify_exit(EXIT_PREEMPTED) == PREEMPTED
+    assert classify_exit(1) == CRASH
+    assert classify_exit(-9) == CRASH  # SIGKILL: code alone can't say "hang"
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class FakeChild:
+    """Scripted child: exits with ``rc`` after ``run_s`` of fake time; a
+    hang child (rc=None) never exits until kill()."""
+
+    def __init__(self, clock, rc, run_s=0.0):
+        self._clock = clock
+        self._deadline = clock() + run_s
+        self._rc = rc
+        self.signals = []
+
+    def poll(self):
+        if self._rc is None or self._clock() < self._deadline:
+            return None
+        return self._rc
+
+    def wait(self):
+        return self._rc if self._rc is not None else -9
+
+    def kill(self):
+        if self._rc is None:
+            self._rc = -9
+        self._deadline = self._clock()
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        # A well-behaved preempted child saves and exits EXIT_PREEMPTED.
+        self._rc = EXIT_PREEMPTED
+        self._deadline = self._clock()
+
+
+class Harness:
+    """Supervisor over a script of FakeChild factories."""
+
+    def __init__(self, cfg, script, jitter=0.0):
+        self.clock = FakeClock()
+        self.spawned = []
+        self.envs = []
+        script = list(script)
+
+        def popen(cmd, env=None, cwd=None):
+            child = script.pop(0)(self.clock)
+            self.spawned.append(child)
+            self.envs.append(env)
+            return child
+
+        class Rng(random.Random):
+            def random(self):  # deterministic jitter
+                return jitter
+
+        self.events = []
+        self.sup = Supervisor(
+            ["train"], cfg,
+            env={}, popen=popen, clock=self.clock, sleep=self.clock.sleep,
+            jitter_rng=Rng(), log_fn=self.events.append,
+            mtime=lambda p: self.mtime,
+        )
+        self.mtime = 0.0
+
+    def kinds(self):
+        return [a.kind for a in self.result.attempts]
+
+    def run(self):
+        self.result = self.sup.run()
+        return self.result
+
+
+def test_backoff_grows_and_caps():
+    cfg = SupervisorConfig(
+        backoff_base_s=1.0, backoff_factor=2.0, backoff_max_s=5.0,
+        backoff_jitter=0.0,
+    )
+    h = Harness(cfg, [])
+    assert [h.sup.backoff_s(i) for i in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+
+
+def test_backoff_jitter_is_multiplicative():
+    cfg = SupervisorConfig(backoff_base_s=2.0, backoff_jitter=0.5)
+    h = Harness(cfg, [], jitter=1.0)  # rng pinned to 1.0 -> full jitter
+    assert h.sup.backoff_s(0) == pytest.approx(2.0 * 1.5)
+
+
+def test_restarts_until_clean_and_counts():
+    cfg = SupervisorConfig(max_restarts=5, backoff_jitter=0.0,
+                           backoff_base_s=1.0, poll_interval_s=0.1)
+    h = Harness(cfg, [
+        lambda c: FakeChild(c, EXIT_FAULT),
+        lambda c: FakeChild(c, 1),
+        lambda c: FakeChild(c, 0),
+    ])
+    r = h.run()
+    assert r.exit_code == 0
+    assert r.restarts == 2
+    assert h.kinds() == [FAULT, CRASH, CLEAN]
+    # Attempt index is exported to each child (fault one-shot gating).
+    assert [e["DDL_SUPERVISOR_ATTEMPT"] for e in h.envs] == ["0", "1", "2"]
+    assert all("DDL_HEARTBEAT_FILE" in e for e in h.envs)
+    # Backoffs actually applied: 1s then 2s of (fake) sleep between attempts.
+    assert [a.backoff_s for a in r.attempts] == [1.0, 2.0, 0.0]
+
+
+def test_gives_up_after_max_restarts():
+    cfg = SupervisorConfig(max_restarts=2, backoff_base_s=0.0,
+                           backoff_jitter=0.0, poll_interval_s=0.1)
+    h = Harness(cfg, [lambda c: FakeChild(c, 3)] * 3)
+    r = h.run()
+    assert r.exit_code == 3
+    assert r.restarts == 2  # 3 attempts = initial + max_restarts
+    assert h.kinds() == [CRASH, CRASH, CRASH]
+    assert any(e.get("event") == "supervisor_give_up" for e in h.events)
+
+
+def test_hang_detection_kills_and_restarts():
+    cfg = SupervisorConfig(hang_timeout_s=10.0, poll_interval_s=1.0,
+                           backoff_base_s=0.0, backoff_jitter=0.0)
+    h = Harness(cfg, [
+        lambda c: FakeChild(c, None),  # hangs forever
+        lambda c: FakeChild(c, 0),
+    ])
+    r = h.run()
+    assert h.kinds() == [HANG, CLEAN]
+    assert r.exit_code == 0
+    # The kill came from staleness: > hang_timeout_s of fake time elapsed
+    # with no mtime change.
+    assert any(e.get("event") == "supervisor_hang_kill" for e in h.events)
+
+
+def test_heartbeat_touch_resets_hang_timer():
+    cfg = SupervisorConfig(hang_timeout_s=10.0, poll_interval_s=4.0,
+                           backoff_base_s=0.0, backoff_jitter=0.0)
+
+    def make_child(c):
+        child = FakeChild(c, None, run_s=0.0)
+        return child
+
+    h = Harness(cfg, [make_child])
+    # Child "runs" 30s of fake time, touching the heartbeat every poll —
+    # mtime changes each check, so staleness never accrues despite
+    # 30s >> hang_timeout_s. Then it exits cleanly.
+    child_holder = {}
+    orig_popen = h.sup._popen
+
+    def popen(cmd, env=None, cwd=None):
+        child = orig_popen(cmd, env=env, cwd=cwd)
+        child._rc, child._deadline = 0, h.clock.t + 30.0
+        child_holder["c"] = child
+        return child
+
+    h.sup._popen = popen
+    ticks = {"n": 0}
+    real_sleep = h.clock.sleep
+
+    def sleep(s):
+        real_sleep(s)
+        ticks["n"] += 1
+        h.mtime = float(ticks["n"])  # the child touched the heartbeat
+
+    h.sup._sleep = sleep
+    r = h.sup.run()
+    assert [a.kind for a in r.attempts] == [CLEAN]
+
+
+def test_hang_detection_off_by_default():
+    cfg = SupervisorConfig(hang_timeout_s=0.0, poll_interval_s=5.0,
+                           backoff_base_s=0.0, backoff_jitter=0.0)
+    h = Harness(cfg, [lambda c: FakeChild(c, 0, run_s=1000.0)])
+    r = h.run()  # would hang-kill within 1000s if detection were armed
+    assert h.kinds() == [CLEAN]
+    assert r.exit_code == 0
+
+
+def test_crash_restart_clears_suspect_cache(tmp_path):
+    """A CRASH exit clears the registered compile-cache dirs before the
+    restart (a dead child may have truncated an entry mid-write, or may be
+    dying ON a cached executable); FAULT and CLEAN exits keep them warm."""
+    cache = tmp_path / "xla"
+
+    def seed_cache():
+        cache.mkdir(exist_ok=True)
+        (cache / "jit_step_fn-entry").write_bytes(b"x")
+
+    seed_cache()
+    cfg = SupervisorConfig(max_restarts=5, backoff_base_s=0.0,
+                           backoff_jitter=0.0, poll_interval_s=0.1)
+    h = Harness(cfg, [
+        lambda c: FakeChild(c, EXIT_FAULT),  # injected fault: keep cache
+        lambda c: FakeChild(c, -11),         # SIGSEGV crash: clear cache
+        lambda c: FakeChild(c, 0),
+    ])
+    h.sup._crash_clear_paths = (str(cache),)
+    seen_after_fault = {}
+    real_backoff = h.sup.backoff_s
+
+    def backoff_s(i):  # runs right after the clear decision for restart i
+        seen_after_fault[i] = cache.exists()
+        return real_backoff(i)
+
+    h.sup.backoff_s = backoff_s
+    r = h.run()
+    assert h.kinds() == [FAULT, CRASH, CLEAN]
+    assert seen_after_fault[0] is True  # fault exit: cache untouched
+    assert seen_after_fault[1] is False  # crash exit: cache gone
+    clears = [e for e in h.events if e.get("event") == "supervisor_cache_clear"]
+    assert len(clears) == 1 and clears[0]["after"] == CRASH
+    assert r.exit_code == 0
+
+
+def test_preemption_forwards_and_stops_restarting():
+    cfg = SupervisorConfig(max_restarts=5, backoff_base_s=0.0,
+                           backoff_jitter=0.0, poll_interval_s=1.0)
+    h = Harness(cfg, [lambda c: FakeChild(c, None)])  # would run forever
+
+    real_sleep = h.clock.sleep
+
+    def sleep(s):
+        real_sleep(s)
+        if h.clock() >= 3.0:
+            h.sup.request_shutdown()  # the SIGTERM handler's body
+
+    h.sup._sleep = sleep
+    r = h.run()
+    assert h.kinds() == [PREEMPTED]
+    assert r.exit_code == EXIT_PREEMPTED
+    assert r.restarts == 0
+    import signal
+
+    assert h.spawned[0].signals == [signal.SIGTERM]
+
+
+def test_preemption_grace_escalates_to_kill():
+    class DeafChild(FakeChild):
+        def send_signal(self, sig):  # ignores SIGTERM
+            self.signals.append(sig)
+
+    cfg = SupervisorConfig(preempt_grace_s=10.0, poll_interval_s=1.0,
+                           backoff_base_s=0.0, backoff_jitter=0.0)
+    h = Harness(cfg, [lambda c: DeafChild(c, None)])
+
+    real_sleep = h.clock.sleep
+
+    def sleep(s):
+        real_sleep(s)
+        if h.clock() >= 2.0:
+            h.sup.request_shutdown()
+
+    h.sup._sleep = sleep
+    r = h.run()
+    # Grace expired -> SIGKILL; terminate flag still stops restarts.
+    assert h.kinds() == [CRASH]
+    assert r.restarts == 0
