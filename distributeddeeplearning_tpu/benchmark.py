@@ -95,6 +95,8 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+
+
 def run_benchmark(
     cfg: Config, *, warmup: int = 5, steps: int = 30,
     latency_steps: int | None = None, fused_probe: int | None = None,
@@ -231,15 +233,29 @@ def run_benchmark(
     # the byte side of the compressed-collectives win (comms_quant.py): an
     # int8 row reads ~4x below the same config at fp32. 0 when dp == 1
     # (nothing to sync over).
-    from .parallel.fsdp import grad_sync_bytes
+    from .parallel.fsdp import grad_sync_bytes, per_device_bytes
+    from .precision import get_policy
 
+    policy = get_policy(cfg.train.precision.policy)
     record["grad_comm"] = cfg.train.grad_comm
     record["grad_sync_bytes_per_step"] = grad_sync_bytes(
         state.params,
         mode=cfg.train.grad_comm,
         block_size=cfg.train.grad_comm_block,
         n_members=mesh.shape["dp"],
+        # Under a mixed policy the partitioner's all-reduce carries the
+        # compute dtype — grads leave the backward pass in bf16.
+        wire_elem_bytes=(
+            policy.compute_dtype.itemsize if policy.mixed else None
+        ),
     )
+    # Mixed-precision telemetry (docs/MIXED_PRECISION.md): the policy plus
+    # the measured per-member DURABLE state footprint it governs (local
+    # shard bytes: replicated leaves count fully, ZeRO-1 shards 1/N).
+    # Transient compute copies/activations show up only in hbm_peak_bytes.
+    record["precision"] = policy.name
+    record["param_bytes_per_member"] = per_device_bytes(state.params)
+    record["opt_state_bytes_per_member"] = per_device_bytes(state.opt_state)
     # HBM telemetry (VERDICT r4 Weak #5): peak bytes decide e.g. whether the
     # batch-512 MFU cell even fits. Key always present — a null must read as
     # "plugin doesn't report", never be confused with "not recorded".

@@ -37,7 +37,14 @@ def build_all(cfg: Config, split: str = "train", devices=None,
 
     # Before any compile this config triggers: every subcommand funnels
     # through build_all, so train/eval/benchmark/generate all warm-start.
+    from .precision import check_precision_composition
+
     enable_compile_cache(cfg.train.compile_cache_dir)
+    # Resolve + fence the mixed-precision policy BEFORE the model build so
+    # an illegal policy/optimizer pair fails by name in milliseconds.
+    policy = check_precision_composition(
+        cfg.train.precision.policy, optim_name=cfg.optim.name
+    )
     mesh = build_mesh(cfg.mesh, devices=devices)
     model = models.get_model(cfg.model.name, **cfg.model.kwargs)
     # Mesh-aware models (ring/Ulysses attention, pipelined stacks) need the
@@ -52,6 +59,27 @@ def build_all(cfg: Config, split: str = "train", devices=None,
                 f"model {cfg.model.name!r} does not support remat"
             )
         updates["remat"] = cfg.train.remat
+    if policy.mixed:
+        # The model's compute dtype is DERIVED from the policy — the two
+        # knobs disagreeing would either waste the policy (model casts the
+        # compute copy back up) or mislead the reader (dtype kwarg ignored).
+        import jax.numpy as jnp
+
+        explicit = cfg.model.kwargs.get("dtype")
+        if explicit is not None and jnp.dtype(explicit) != policy.compute_dtype:
+            raise ValueError(
+                f"model.kwargs.dtype={explicit!r} conflicts with "
+                f"train.precision.policy={policy.name!r} (compute dtype "
+                f"{policy.compute_dtype.name}): drop model.kwargs.dtype — "
+                "the policy owns the compute dtype (docs/MIXED_PRECISION.md)"
+            )
+        if not hasattr(model, "dtype"):
+            raise ValueError(
+                f"model {cfg.model.name!r} has no dtype field, so "
+                f"train.precision.policy={policy.name!r} cannot set its "
+                "compute dtype — use precision policy 'fp32'"
+            )
+        updates["dtype"] = policy.compute_dtype
     if updates:
         model = model.clone(**updates)
     tx = make_optimizer(
@@ -65,6 +93,7 @@ def build_all(cfg: Config, split: str = "train", devices=None,
         schedule=cfg.optim.schedule,
         total_steps=cfg.train.steps,
         grad_clip=cfg.optim.grad_clip,
+        precision=policy,
     )
     trainer_kw = {}
     if cfg.train.sequence_parallel:
@@ -88,6 +117,7 @@ def build_all(cfg: Config, split: str = "train", devices=None,
         zero1=cfg.train.zero1,
         grad_comm=cfg.train.grad_comm,
         grad_comm_block=cfg.train.grad_comm_block,
+        precision=policy,
         # Trainer gates on health.enabled itself; passing it unconditionally
         # keeps the TrainState schema (health field present/absent)
         # consistent across train/eval/generate for one config.

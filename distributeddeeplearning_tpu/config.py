@@ -125,6 +125,24 @@ class OptimConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PrecisionConfig:
+    """Mixed-precision dtype policy (``precision.py``). A config BLOCK (not
+    a bare string field) so future per-axis knobs — fp8 scaling recipes,
+    per-collection compute dtypes — land here without a schema break.
+
+    ``policy``: ``fp32`` (default; the step program is bit-identical to a
+    build without the subsystem), ``bf16`` (fp32 master params in
+    TrainState, bf16 compute copy cast per step for fwd/bwd — activations
+    and gradient collectives bf16, optimizer update fp32 on masters), or
+    ``bf16_full`` (additionally stores Adam moments in bf16 with
+    stochastic rounding — requires ``optim.name='adamw'``). See
+    docs/MIXED_PRECISION.md.
+    """
+
+    policy: str = "fp32"
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     steps: int = 100
     log_every: int = 10
@@ -158,6 +176,14 @@ class TrainConfig:
     # modes are pure-DP only in v1 (the Trainer fences compositions).
     grad_comm: str = "fp32"
     grad_comm_block: int = 256  # int8 quantization block size (elements)
+    # Mixed-precision policy block (precision.py; docs/MIXED_PRECISION.md).
+    # Select with --override train.precision.policy=bf16 — NOT via
+    # model.kwargs.dtype, which would train bf16 parameters with no fp32
+    # masters behind them (cli.build_all clones the model's dtype from the
+    # policy and rejects a conflicting explicit model.kwargs.dtype).
+    precision: PrecisionConfig = dataclasses.field(
+        default_factory=PrecisionConfig
+    )
     # Persistent XLA compilation cache (jax_compilation_cache_dir): real
     # runs warm-start their compiles across restarts/resumes — previously
     # only the test harness set this (tests/conftest.py). Applied by
@@ -299,6 +325,14 @@ def _coerce(value, current, dotted: str):
     if isinstance(current, (int, float)):
         raise ValueError(
             f"{dotted}: {value!r} is not a valid {type(current).__name__}"
+        )
+    if dataclasses.is_dataclass(current):
+        # e.g. ``train.precision=bf16`` would silently replace the nested
+        # PrecisionConfig with a bare string; demand the field path.
+        names = ", ".join(f.name for f in dataclasses.fields(current))
+        raise ValueError(
+            f"{dotted} is a config block, not a field — set "
+            f"{dotted}.<field>=... (fields: {names})"
         )
     return value
 

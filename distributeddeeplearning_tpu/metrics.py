@@ -35,6 +35,12 @@ class DeferredMetrics:
 
     Contract, exactly: after ``push(n)``, intervals ``1..n-1`` have been
     emitted and ``n`` is pending; ``flush()`` emits the pending one.
+
+    Dtype note (docs/MIXED_PRECISION.md): this class only TRANSFERS one
+    interval's device scalars — it never sums across steps, so a bf16
+    compute policy cannot degrade anything here. The cross-step fp32
+    accumulation contracts live where sums happen: ``train.evaluate``
+    (metric sums) and the grad-accum microbatch scan (``train.py``).
     """
 
     def __init__(self, emit):

@@ -158,6 +158,41 @@ class FusedAdamWTransformation(NamedTuple):
     grad_clip: float = 0.0
 
 
+def stochastic_round(x, key, dtype=jnp.bfloat16):
+    """fp32 -> bf16 with stochastic rounding: round up with probability
+    proportional to the distance to the next representable value.
+
+    Bit trick: bf16 is fp32's top 16 bits, so adding uniform random low-16
+    bits to the fp32 bit pattern and truncating rounds each value up with
+    exactly ``frac = low_bits / 2^16`` probability — unbiased in
+    expectation, which is the whole point: round-to-nearest on a moment
+    EMA ``mu <- b1*mu + (1-b1)*g`` deterministically drops any ``g``
+    contribution below one bf16 ulp of ``mu``, and the moment stalls.
+    (Used by ``train.low_precision_adamw`` for the ``bf16_full`` policy.)
+
+    Non-finite values bypass the add (carry past the mantissa would walk
+    inf into nan space) and cast directly — the health guard must see the
+    same nan/inf the fp32 math produced. Values within one bf16 ulp of
+    ``bf16_max`` can round up to inf; Adam moments live many orders of
+    magnitude below that.
+    """
+    if jnp.dtype(dtype) != jnp.bfloat16:
+        raise NotImplementedError(
+            f"stochastic_round targets bfloat16 (got {jnp.dtype(dtype)}): "
+            "the truncation trick needs the target to be the source's "
+            "high bits"
+        )
+    bits = jax.lax.bitcast_convert_type(
+        jnp.asarray(x, jnp.float32), jnp.uint32
+    )
+    noise = jax.random.bits(key, jnp.shape(x), jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+    out = jax.lax.bitcast_convert_type(rounded, jnp.float32).astype(
+        jnp.bfloat16
+    )
+    return jnp.where(jnp.isfinite(x), out, jnp.asarray(x).astype(jnp.bfloat16))
+
+
 def decay_leaf(p) -> bool:
     """THE weight-decay rule, defined once: matrices/embeddings (ndim>=2)
     decay; biases and norm scales (ndim<2) don't. Used by this kernel, by

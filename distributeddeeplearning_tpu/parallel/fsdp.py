@@ -65,7 +65,12 @@ def _spec_uses(spec, axis: str) -> bool:
 
 
 def grad_sync_bytes(
-    tree, *, mode: str = "fp32", block_size: int = 256, n_members: int = 2
+    tree,
+    *,
+    mode: str = "fp32",
+    block_size: int = 256,
+    n_members: int = 2,
+    wire_elem_bytes: float | None = None,
 ) -> int:
     """Per-member wire bytes of one data-parallel gradient sync of ``tree``.
 
@@ -75,6 +80,12 @@ def grad_sync_bytes(
     (comms_quant: int8 values + one f32 scale per ``block_size`` — ~4x under
     fp32). ``bench.py`` / ``benchmark.py`` report this next to measured
     step time so the byte win per mode is visible without an HLO dump.
+
+    ``wire_elem_bytes`` overrides the uncompressed element width — under a
+    mixed-precision policy grads leave the backward pass in the compute
+    dtype, so the fp32-mode all-reduce actually ships 2 B/elem (the
+    compressed modes already quantize from whatever width arrives, so their
+    scale/value payload is unchanged).
     """
     from ..comms_quant import compression_ratio
 
@@ -83,7 +94,10 @@ def grad_sync_bytes(
         for leaf in jax.tree.leaves(tree)
     )
     per_hop = -(-n_elems // n_members)  # ceil: ring chunks are padded equal
-    bytes_per_elem = 4.0 * compression_ratio(mode, block_size)
+    if mode == "fp32" and wire_elem_bytes is not None:
+        bytes_per_elem = float(wire_elem_bytes)
+    else:
+        bytes_per_elem = 4.0 * compression_ratio(mode, block_size)
     return int(2 * (n_members - 1) * per_hop * bytes_per_elem)
 
 

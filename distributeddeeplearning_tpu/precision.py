@@ -1,0 +1,165 @@
+"""Mixed-precision dtype policies — fp32 master weights, bf16 compute.
+
+The principled version of the old per-model ``model.kwargs.dtype`` knob
+(docs/TUNING.md item 1). That knob is a COMPUTE-only cast at every param
+use inside the modules (flax ``dtype`` semantics; param storage stays
+fp32), so the cast boundary sits at each read: gradients arrive back in
+fp32 — the gradient collectives, optimizer state and checkpoints see
+none of the bf16 savings — and nothing fences what the knob composes
+with. A :class:`Policy` splits the roles explicitly instead:
+
+- **master params** (``param_dtype``, fp32): the durable ``TrainState``
+  tree — what the optimizer updates and what checkpoints store. The saved
+  schema is therefore IDENTICAL across ``fp32`` and ``bf16`` policies:
+  the policy is a property of the step program, not of the state.
+- **compute copy** (``compute_dtype``): cast from the masters at the top
+  of each step body, fed to fwd/bwd. Gradients come back in
+  ``compute_dtype`` — which is what halves the partitioner-emitted grad
+  all-reduce (and, under ZeRO-1-sharded masters, the param all-gather)
+  payloads — then are cast up to fp32 before instrumentation, clipping
+  and the optimizer update.
+- **optimizer moments** (``moment_dtype``): ``bf16_full`` stores Adam
+  moments in bf16 with stochastic rounding on the moment update
+  (``ops/fused_adamw.stochastic_round``), halving optimizer-state HBM on
+  top of the compute win.
+
+Within the model, loss/softmax/layer-norm statistics stay fp32 through the
+models' existing ``dtype`` plumbing (attention softmax, ``layer_norm``
+stats and the final-logit cast are fp32 regardless of compute dtype) — the
+policy reuses that field rather than re-plumbing the models, so
+``cli.build_all`` clones the model with ``dtype=compute_dtype`` and the
+Trainer fences a mismatch (a model left at fp32 would silently cast the
+bf16 compute copy back up at every use: all cost, no win).
+
+The ``fp32`` policy is a PYTHON-LEVEL no-op: every cast helper returns its
+input unchanged, so the traced step program — and its compiled HLO — is
+bit-identical to a build that never heard of this module (pinned by
+``tests/test_precision.py``'s golden-identity test).
+
+The enum stays open for fp8 (per-tensor scaling would ride the same
+master/compute split); ``POLICIES`` is the single source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+POLICIES: tuple[str, ...] = ("fp32", "bf16", "bf16_full")
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Resolved dtype policy. ``param_dtype`` is the master/durable dtype,
+    ``compute_dtype`` what fwd/bwd (and the gradient collectives with
+    ``grad_comm='fp32'``) run in, ``moment_dtype`` the Adam moment storage
+    dtype (``stochastic_rounding`` governs the fp32->moment_dtype write)."""
+
+    name: str
+    param_dtype: jnp.dtype
+    compute_dtype: jnp.dtype
+    moment_dtype: jnp.dtype
+    stochastic_rounding: bool = False
+
+    @property
+    def mixed(self) -> bool:
+        """True when a distinct compute copy is cast per step."""
+        return self.compute_dtype != self.param_dtype
+
+
+_F32 = jnp.dtype(jnp.float32)
+_BF16 = jnp.dtype(jnp.bfloat16)
+
+_POLICY_TABLE = {
+    "fp32": Policy("fp32", _F32, _F32, _F32),
+    "bf16": Policy("bf16", _F32, _BF16, _F32),
+    "bf16_full": Policy(
+        "bf16_full", _F32, _BF16, _BF16, stochastic_rounding=True
+    ),
+}
+assert tuple(_POLICY_TABLE) == POLICIES
+
+
+def get_policy(policy: str | Policy) -> Policy:
+    """Resolve a policy name (``train.precision.policy``) to a
+    :class:`Policy`; passes an already-resolved Policy through."""
+    if isinstance(policy, Policy):
+        return policy
+    if policy not in _POLICY_TABLE:
+        raise ValueError(
+            f"train.precision.policy={policy!r} not in {POLICIES} "
+            "(fp32 = no-op; bf16 = fp32 masters + bf16 compute; bf16_full "
+            "= bf16 compute + bf16 Adam moments with stochastic rounding)"
+        )
+    return _POLICY_TABLE[policy]
+
+
+def _tree_cast(tree, dtype):
+    """Cast every floating leaf; integer leaves (counters, token tables)
+    pass through untouched."""
+    return jax.tree.map(
+        lambda x: (
+            x.astype(dtype)
+            if jnp.issubdtype(jnp.result_type(x), jnp.floating)
+            else x
+        ),
+        tree,
+    )
+
+
+def cast_to_compute(policy: Policy, params):
+    """Masters -> compute copy for one step body. The fp32 policy returns
+    the INPUT OBJECT (no tree_map, no convert ops): the fp32 trace is
+    byte-identical to the pre-policy program."""
+    if not policy.mixed:
+        return params
+    return _tree_cast(params, policy.compute_dtype)
+
+
+def cast_grads_to_update(policy: Policy, grads):
+    """Compute-dtype grads -> fp32 for instrumentation/clipping/update.
+    Placed AFTER the gradient sync in every step body, so the synced
+    payload stays in ``compute_dtype`` while everything the optimizer and
+    the health guard see is fp32. fp32 policy: identity."""
+    if not policy.mixed:
+        return grads
+    return _tree_cast(grads, policy.param_dtype)
+
+
+def check_precision_composition(
+    policy: str | Policy,
+    *,
+    optim_name: str | None = None,
+) -> Policy:
+    """Config-time fences for the policy x optimizer axis — called by
+    ``cli.build_all`` (and ``train.make_optimizer``) BEFORE anything
+    compiles, so an illegal pair fails by name in milliseconds.
+
+    The policy x model axes (pipelined models, model-dtype mismatch) are
+    fenced in ``Trainer.__init__`` — they need the constructed model.
+    """
+    p = get_policy(policy)
+    if p.moment_dtype != p.param_dtype and optim_name is not None:
+        if optim_name == "sgd":
+            raise ValueError(
+                f"precision={p.name!r} x optim.name='sgd' is unsupported: "
+                "low-precision moment storage targets Adam's two fp32 "
+                "moment trees (SGD momentum is one tree and not the HBM "
+                "bottleneck) — use optim.name='adamw' or precision='bf16'"
+            )
+        if optim_name == "adamw_fused":
+            raise ValueError(
+                f"precision={p.name!r} x optim.name='adamw_fused' is "
+                "unsupported in v1: the Pallas kernel's moment buffers are "
+                "fp32 (ops/fused_adamw.py) — use optim.name='adamw' for "
+                "bf16 moments, or precision='bf16' to keep the fused kernel"
+            )
+        if optim_name != "adamw":
+            raise ValueError(
+                f"precision={p.name!r} requires optim.name='adamw' "
+                f"(got {optim_name!r}): low-precision moments are an Adam "
+                "state layout"
+            )
+    return p

@@ -30,6 +30,13 @@ from flax import linen as nn
 from flax import struct
 from jax.sharding import Mesh
 
+from .precision import (
+    Policy,
+    cast_grads_to_update,
+    cast_to_compute,
+    check_precision_composition,
+    get_policy,
+)
 from .sharding import (
     DEFAULT_LOGICAL_RULES,
     activation_mesh,
@@ -196,6 +203,104 @@ def get_task(name: str, **task_kwargs) -> Task:
 # ---------------------------------------------------------------------------
 
 
+class LowPrecisionAdamWState(NamedTuple):
+    """AdamW state with moments stored in a low-precision dtype
+    (``precision.py`` policy ``bf16_full``). Same (count, mu, nu) layout as
+    the fused kernel's state so ``parallel/zero.shard_opt_state_shardings``
+    shards it identically — but a distinct type, so ``Trainer._tx_update``'s
+    ``FusedAdamWState`` shard_map dispatch never fires on it."""
+
+    count: jax.Array
+    mu: Any
+    nu: Any
+
+
+def low_precision_adamw(
+    learning_rate,
+    *,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mask=None,
+    moment_dtype=jnp.bfloat16,
+    stochastic_rounding: bool = True,
+) -> optax.GradientTransformation:
+    """AdamW whose moment trees LIVE in ``moment_dtype`` (HBM halved vs the
+    fp32 trees — the ``bf16_full`` policy) while every update is COMPUTED in
+    fp32: moments are cast up, advanced, applied to the fp32 master params,
+    and only the store back to ``moment_dtype`` narrows — with stochastic
+    rounding (``ops/fused_adamw.stochastic_round``), since round-to-nearest
+    on ``mu <- b1*mu + (1-b1)*g`` would deterministically drop any ``g``
+    below one bf16 ulp of ``mu`` and the moment EMA stalls exactly like
+    bf16 master weights do. Matches ``optax.adamw`` update math (bias
+    correction at the incremented count, decoupled weight decay on
+    ``mask``-ed leaves, schedule evaluated at the pre-increment count)."""
+    from .ops.fused_adamw import stochastic_round
+
+    sched = (
+        learning_rate if callable(learning_rate)
+        else optax.constant_schedule(learning_rate)
+    )
+    moment_dtype = jnp.dtype(moment_dtype)
+
+    def init_fn(params):
+        zeros = lambda t: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros(jnp.shape(p), moment_dtype), t
+        )
+        return LowPrecisionAdamWState(
+            count=jnp.zeros((), jnp.int32), mu=zeros(params), nu=zeros(params)
+        )
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("low_precision_adamw requires params")
+        count = optax.safe_int32_increment(state.count)
+        lr = sched(state.count)
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        decay = (
+            mask(params) if mask is not None
+            else jax.tree.map(lambda _: True, params)
+        )
+        # One deterministic key per (step, leaf): resume from a checkpoint
+        # replays the same rounding stream — no RNG threaded through state.
+        key = jax.random.fold_in(jax.random.PRNGKey(0x5F3759), count)
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat = []
+        for i, (g, mu, nu, p, d) in enumerate(zip(
+            flat_g,
+            treedef.flatten_up_to(state.mu),
+            treedef.flatten_up_to(state.nu),
+            treedef.flatten_up_to(params),
+            treedef.flatten_up_to(decay),
+        )):
+            g32 = g.astype(jnp.float32)
+            mu32 = b1 * mu.astype(jnp.float32) + (1.0 - b1) * g32
+            nu32 = b2 * nu.astype(jnp.float32) + (1.0 - b2) * jnp.square(g32)
+            upd = (mu32 / c1) / (jnp.sqrt(nu32 / c2) + eps)
+            if weight_decay:
+                upd = jnp.where(d, upd + weight_decay * p.astype(jnp.float32), upd)
+            if stochastic_rounding:
+                mu_store = stochastic_round(mu32, jax.random.fold_in(key, 2 * i))
+                nu_store = stochastic_round(
+                    nu32, jax.random.fold_in(key, 2 * i + 1)
+                )
+            else:
+                mu_store = mu32.astype(moment_dtype)
+                nu_store = nu32.astype(moment_dtype)
+            flat.append(((-lr * upd).astype(p.dtype), mu_store, nu_store))
+        unflatten = lambda xs: jax.tree.unflatten(treedef, xs)  # noqa: E731
+        return unflatten([f[0] for f in flat]), LowPrecisionAdamWState(
+            count=count,
+            mu=unflatten([f[1] for f in flat]),
+            nu=unflatten([f[2] for f in flat]),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(
     name: str = "sgd",
     lr: float = 0.1,
@@ -208,6 +313,7 @@ def make_optimizer(
     schedule: str = "constant",
     total_steps: int = 0,
     grad_clip: float = 0.0,
+    precision: str | Policy = "fp32",
 ) -> optax.GradientTransformation:
     if schedule == "constant":
         sched = optax.constant_schedule(lr)
@@ -234,6 +340,10 @@ def make_optimizer(
 
     decay_mask = lambda params: jax.tree.map(decay_leaf, params)  # noqa: E731
 
+    # Policy x optimizer fence (precision.py): bf16_full's low-precision
+    # moments are an adamw-only state layout — fails HERE, config-time.
+    policy = check_precision_composition(precision, optim_name=name)
+
     if name == "sgd":
         tx = optax.sgd(sched, momentum=momentum, nesterov=False)
         if weight_decay:
@@ -242,9 +352,16 @@ def make_optimizer(
                 tx,
             )
     elif name == "adamw":
-        tx = optax.adamw(
-            sched, b1=b1, b2=b2, weight_decay=weight_decay, mask=decay_mask
-        )
+        if policy.moment_dtype != policy.param_dtype:
+            tx = low_precision_adamw(
+                sched, b1=b1, b2=b2, weight_decay=weight_decay,
+                mask=decay_mask, moment_dtype=policy.moment_dtype,
+                stochastic_rounding=policy.stochastic_rounding,
+            )
+        else:
+            tx = optax.adamw(
+                sched, b1=b1, b2=b2, weight_decay=weight_decay, mask=decay_mask
+            )
     elif name == "adamw_fused":
         from .ops.fused_adamw import fused_adamw
 
@@ -319,6 +436,7 @@ class Trainer:
         allow_idle_axes: bool = False,
         grad_comm: str = "fp32",
         grad_comm_block: int = 256,
+        precision: str | Policy = "fp32",
         health: Any = None,
         fault_nan_step: int | None = None,
     ):
@@ -375,6 +493,32 @@ class Trainer:
                 )
         self.grad_comm = grad_comm
         self.grad_comm_block = grad_comm_block
+        # Mixed-precision policy (precision.py): fp32 masters in TrainState,
+        # a compute copy cast per step. Model-facing fences live here (the
+        # config-time optimizer fence is check_precision_composition).
+        self.precision = get_policy(precision)
+        if self.precision.mixed:
+            if hasattr(model, "num_stages"):
+                raise NotImplementedError(
+                    f"precision={self.precision.name!r} x pipelined model "
+                    f"{type(model).__name__} is unsupported in v1: the 1f1b "
+                    "engine differentiates inside its schedule on the "
+                    "model's own dtype, so there is no seam for the "
+                    "master->compute cast — use precision='fp32'"
+                )
+            model_dtype = jnp.dtype(getattr(model, "dtype", jnp.float32))
+            if model_dtype != self.precision.compute_dtype:
+                raise ValueError(
+                    f"precision={self.precision.name!r} requires model.dtype"
+                    f"={self.precision.compute_dtype.name!r} (got "
+                    f"{model_dtype.name!r}): the step casts a "
+                    f"{self.precision.compute_dtype.name} compute copy of "
+                    "the fp32 masters, and a model at another dtype would "
+                    "cast it straight back at every use — all cost, no win. "
+                    "cli.build_all derives the model dtype from "
+                    "train.precision; direct Trainer users pass "
+                    "model.clone(dtype=...)"
+                )
         # Composition fences (VERDICT r4 Missing #4): every {dp,fsdp,tp,pp,
         # cp,ep} pair either composes (tested) or fails HERE by name. The
         # unsupported-composition fence (pipeline x ep/cp) is unconditional;
@@ -504,6 +648,26 @@ class Trainer:
                     self.mesh,
                 )
             )
+            if self.precision.mixed and self.grad_comm == "fp32":
+                # ZeRO-1 x mixed precision = weight-update sharding done
+                # right (cf. "Automatic Cross-Replica Sharding of Weight
+                # Update in Data-Parallel Training"): shard the fp32
+                # MASTERS over dp like the moments — the update is
+                # shard-local, and the only per-step param traffic is the
+                # all-gather of the *compute-dtype copy* (the elementwise
+                # cast preserves the sharded layout, so the partitioner
+                # gathers bf16 — half the bytes of gathering fp32 masters).
+                # Skipped under lossy grad_comm: its shard_map body takes
+                # params with their rules-derived (replicated-over-dp)
+                # in_specs, and dp-sharded masters would be resharded back
+                # every step for no win.
+                self.state_shardings = self.state_shardings.replace(
+                    params=shard_opt_state_shardings(
+                        self.state_shardings.params,
+                        self.abstract_state.params,
+                        self.mesh,
+                    )
+                )
         if self.grad_comm != "fp32":
             from .parallel.zero import residual_shardings
 
@@ -767,9 +931,16 @@ class Trainer:
             # masks across batch shards (the auto path draws one global
             # mask).
             rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+            cparams = cast_to_compute(self.precision, params)
             (_, (metrics, updates)), grads = jax.value_and_grad(
                 self._loss_and_updates, has_aux=True
-            )(params, model_state, batch, rng, True)
+            )(cparams, model_state, batch, rng, True)
+            # Up-cast BEFORE the ring: ``quantized_tree_all_reduce`` owns
+            # the wire compression (bf16/int8 payloads either way), and its
+            # ravel_pytree unravel restores the INPUT leaf dtypes — bf16
+            # grads here would silently demote both the summed grads and
+            # the fp32 error-feedback residual schema.
+            grads = cast_grads_to_update(self.precision, grads)
             residual = jax.tree.map(lambda r: r[0], residual)
             summed, new_residual = comms_quant.quantized_tree_all_reduce(
                 grads, "dp", mode=mode, block_size=block, residual=residual
@@ -824,6 +995,15 @@ class Trainer:
     def _plain_step_fn(self):
         def step_fn(state: TrainState, batch):
             rng = fold_in_step(state.rng, state.step)
+            # Mixed precision: ONE compute copy per step, cast OUTSIDE
+            # value_and_grad and differentiated directly — so fwd/bwd dots
+            # AND the gradient leaves are compute-dtype (the partitioner's
+            # grad all-reduce moves half the bytes), while the masters in
+            # ``state.params`` are only touched by the fp32 update below.
+            # Sits INSIDE the (possibly fused-scanned) body, so K-step
+            # dispatch re-casts from the updated masters every step.
+            # fp32 policy: returns state.params itself — identical trace.
+            cparams = cast_to_compute(self.precision, state.params)
 
             if self.grad_accum > 1:
                 # Microbatch scan: batch leading dim is split into
@@ -835,7 +1015,7 @@ class Trainer:
                     grads_acc, metrics_acc, mstate = carry
                     (loss, (metrics, updates)), grads = jax.value_and_grad(
                         self._loss_and_updates, has_aux=True
-                    )(state.params, mstate, mb, jax.random.fold_in(rng, idx), True)
+                    )(cparams, mstate, mb, jax.random.fold_in(rng, idx), True)
                     grads_acc = jax.tree.map(
                         lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
                     )
@@ -853,7 +1033,7 @@ class Trainer:
                 )
                 abs_out = jax.eval_shape(
                     lambda: self._loss_and_updates(
-                        state.params, state.model_state,
+                        cparams, state.model_state,
                         jax.tree.map(lambda x: x[0], mb0), rng, True,
                     )[1][0]
                 )
@@ -870,8 +1050,13 @@ class Trainer:
             else:
                 (_, (metrics, updates)), grads = jax.value_and_grad(
                     self._loss_and_updates, has_aux=True
-                )(state.params, state.model_state, batch, rng, True)
+                )(cparams, state.model_state, batch, rng, True)
 
+            # Grads -> fp32 AFTER the (partitioner-emitted) sync, BEFORE
+            # instrumentation/clipping/update: poison, the guard's norm and
+            # the optimizer all see fp32. No-op for fp32 policy and for the
+            # grad_accum path (already accumulated fp32).
+            grads = cast_grads_to_update(self.precision, grads)
             grads, metrics = self._instrument_grads(grads, state.step, metrics)
             updates_tx, new_opt_state = self._tx_update(
                 grads, state.opt_state, state.params
@@ -978,7 +1163,18 @@ class Trainer:
                 _, (metrics, _) = self._loss_and_updates(
                     state.params, state.model_state, batch, state.rng, False
                 )
-                return metrics
+                # Eval metrics leave the device fp32 regardless of the
+                # model's compute dtype: evaluate() sums them across
+                # batches, and a bf16 running sum loses integer resolution
+                # past 256. Same-dtype cast is a trace-level no-op, so the
+                # fp32 eval program is unchanged.
+                return jax.tree.map(
+                    lambda m: (
+                        m.astype(jnp.float32)
+                        if jnp.issubdtype(m.dtype, jnp.inexact) else m
+                    ),
+                    metrics,
+                )
 
             self._eval_step = MeshedJit(
                 jax.jit(
@@ -1061,10 +1257,19 @@ def evaluate(trainer: Trainer, state: TrainState, batches) -> dict[str, float]:
     count = 0
     for batch in batches:
         metrics = trainer.eval_step(state, batch)
-        sums = (
-            metrics if sums is None
-            else jax.tree.map(jnp.add, sums, metrics)
-        )
+        if sums is None:
+            # fp32 accumulator regardless of the model's compute dtype
+            # (eval_step already pins its outputs to fp32; this guards
+            # custom/mocked eval steps too — jnp.add promotes to it).
+            sums = jax.tree.map(
+                lambda v: (
+                    v.astype(jnp.float32)
+                    if jnp.issubdtype(jnp.result_type(v), jnp.inexact) else v
+                ),
+                metrics,
+            )
+        else:
+            sums = jax.tree.map(jnp.add, sums, metrics)
         count += 1
     if count == 0:
         raise ValueError("evaluate() got an empty batch iterable")

@@ -76,6 +76,27 @@ def pytest_configure(config):
         "markers",
         "slow: heavy parity cases excluded from the tier-1 fast lane",
     )
+    # Numerics assertions that only hold on real MXU hardware (bf16 dot
+    # accumulation, stochastic-rounding interaction with the matrix units).
+    # Distinct from `tpu` (which any chip-touching test uses): `tpu_only`
+    # declares the ASSERTION is meaningless on the CPU sim, not just that
+    # the test wants a chip.
+    config.addinivalue_line(
+        "markers",
+        "tpu_only: asserts real-MXU numerics; auto-skipped without a chip",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if TPU_POOL_IPS:
+        return
+    skip = pytest.mark.skip(
+        reason="tpu_only: real-MXU numerics assertion, no chip attached "
+        "(PALLAS_AXON_POOL_IPS unset)"
+    )
+    for item in items:
+        if "tpu_only" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture

@@ -114,6 +114,80 @@ def mesh_of(**axes):
     return build_mesh(MeshConfig(**axes), devices=jax.devices()[:n])
 
 
+def compiled_step_text(trainer, example_batch, mesh, *, spmd: bool = False):
+    """Compile ``trainer.train_step`` abstractly (ShapeDtypeStructs with the
+    real batch sharding — no data materialized) and return HLO text.
+
+    ``spmd=False``: the fully optimized backend module — what actually runs.
+    ``spmd=True``: the module as the SPMD partitioner emitted it (dumped via
+    per-compile ``xla_dump_hlo_pass_re``), BEFORE backend float
+    normalization. That is the honest view of collective payload dtypes:
+    the CPU sim's float-support pass promotes bf16 all-reduces to f32
+    (``_promoted`` regions in the optimized text) because CPU has no native
+    bf16 arithmetic, while a TPU build keeps them bf16 — so mixed-precision
+    byte assertions must read this stage. Shared by test_grad_comm,
+    test_precision and test_hlo_bytes instead of per-file copies.
+    """
+    import glob
+    import shutil
+    import tempfile
+
+    from distributeddeeplearning_tpu.sharding import batch_sharding
+
+    import numpy as np
+
+    trainer.setup(example_batch)
+    bsh = batch_sharding(mesh)
+    abs_batch = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            np.asarray(x).shape, np.asarray(x).dtype, sharding=bsh
+        ),
+        dict(example_batch),
+    )
+    lowered = trainer.train_step.lower(
+        trainer.abstract_state_with_shardings(), abs_batch
+    )
+    if not spmd:
+        return lowered.compile().as_text()
+    dump = tempfile.mkdtemp(prefix="ddl_hlo_dump_")
+    # The persistent compile cache (conftest) would satisfy this compile
+    # without running any pass — and an executable fetched from cache dumps
+    # nothing. Dump options are scrubbed from the cache key, so a prior
+    # plain compile of the same program (e.g. the golden-identity test)
+    # silently starves the dump; disable the cache for this one compile.
+    cache_dir = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        lowered.compile(
+            {"xla_dump_to": dump, "xla_dump_hlo_pass_re": "spmd"}
+        )
+        paths = glob.glob(os.path.join(dump, "*after_spmd-partitioning*"))
+        assert len(paths) == 1, (
+            f"expected exactly one post-partitioner dump, got {paths}"
+        )
+        with open(paths[0]) as f:
+            return f.read()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        shutil.rmtree(dump, ignore_errors=True)
+
+
+def sync_wire_bytes(text: str, n: int) -> float:
+    """Ring-model per-member wire bytes of the dp-group collectives — the
+    same accounting tools/project_scaling.py reports per grad_comm mode.
+    Robust to the CPU SPMD emitter's op choices (e.g. reduce-scatter
+    lowered as all-reduce + dynamic-slice) because it totals over kinds."""
+    from distributeddeeplearning_tpu.utils.hlo import collective_bytes
+
+    factors = {"all-reduce": 2 * (n - 1) / n, "collective-permute": 1.0}
+    total = 0.0
+    for kind, entries in collective_bytes(text, n).items():
+        for payload, group in entries:
+            if group >= n // 2:
+                total += factors.get(kind, (n - 1) / n) * payload
+    return total
+
+
 def train_tiny_gpt2(
     mesh,
     *,
@@ -122,15 +196,25 @@ def train_tiny_gpt2(
     n_steps: int = 5,
     batch_size: int = 16,
     seq_len: int = 32,
+    dtype=None,
     **trainer_kw,
 ):
     """Train the tiny GPT-2 for ``n_steps`` on synthetic tokens; returns
     (per-step losses, final TrainState). Deterministic in everything except
-    the mesh/sharding, which is what parity tests compare across."""
+    the mesh/sharding, which is what parity tests compare across.
+
+    ``dtype`` sets the model compute dtype (the precision tests pair it with
+    ``precision="bf16"``, mirroring what cli.build_all derives from the
+    config); a ``precision`` trainer kwarg is forwarded to make_optimizer
+    too, so bf16_full gets its low-precision moment transform."""
+    model_kw = {}
+    if dtype is not None:
+        model_kw["dtype"] = dtype
     model = models.get_model(
         "gpt2", size="tiny", vocab_size=256, max_len=64, dropout_rate=0.0,
         attn_impl=attn_impl,
         mesh=mesh if attn_impl in ("ring", "ring_pallas") else None,
+        **model_kw,
     )
     ds = data_lib.SyntheticTokens(
         batch_size=batch_size, seq_len=seq_len, vocab_size=256, seed=0,
@@ -140,9 +224,10 @@ def train_tiny_gpt2(
     if rules is not None:
         kw["rules"] = rules
     kw.update(trainer_kw)
-    trainer = Trainer(
-        model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh, **kw
+    opt = make_optimizer(
+        "adamw", 1e-3, precision=kw.get("precision", "fp32")
     )
+    trainer = Trainer(model, opt, get_task("lm"), mesh, **kw)
     state = trainer.init(0, ds.batch(0))
     losses = []
     for i, batch in enumerate(data_lib.sharded_batches(ds, mesh)):

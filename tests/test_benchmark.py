@@ -48,6 +48,11 @@ def test_run_benchmark_record_contract():
     # Per-step latency percentiles ride along by default (dispatch-overhead
     # telemetry): nearest-rank over a synchronized window, so p90 >= p50.
     assert record["p90_step_ms"] >= record["p50_step_ms"] > 0
+    # Mixed-precision telemetry: policy name plus measured per-member
+    # durable-state footprints (fp32 here — the default config).
+    assert record["precision"] == "fp32"
+    assert record["param_bytes_per_member"] > 0
+    assert record["opt_state_bytes_per_member"] >= 0
     # The record must be JSON-serializable as-is (driver contract: one line).
     json.dumps(record)
 

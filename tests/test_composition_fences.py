@@ -87,3 +87,132 @@ def test_allow_idle_axes_escape_hatch():
         model, make_optimizer("adamw", 1e-3), get_task("lm"), mesh,
         allow_idle_axes=True,
     )
+
+
+# ---------------------------------------------------------------------------
+# Mixed precision (train.precision) x everything else
+#
+# The matrix the docs promise (docs/MIXED_PRECISION.md): legal pairs must
+# BUILD (their numerics are pinned in test_precision.py), illegal pairs must
+# fail at config/Trainer-build time with an error naming the pair and the
+# way out.
+# ---------------------------------------------------------------------------
+
+
+def _bf16_model(**kw):
+    import jax.numpy as jnp
+
+    return models.get_model(
+        "gpt2", size="tiny", vocab_size=64, max_len=32, dropout_rate=0.0,
+        dtype=jnp.bfloat16, **kw,
+    )
+
+
+def _precision_trainer(model, mesh, precision="bf16", optim="adamw", **kw):
+    return Trainer(
+        model, make_optimizer(optim, 1e-3, precision=precision),
+        get_task("lm"), mesh, donate=False, precision=precision, **kw,
+    )
+
+
+@pytest.mark.parametrize(
+    "trainer_kw",
+    [
+        dict(grad_comm="int8"),
+        dict(grad_comm="bf16"),
+        dict(zero1=True),
+        dict(grad_accum=2),
+        dict(fault_nan_step=1),
+    ],
+    ids=["grad_comm-int8", "grad_comm-bf16", "zero1", "grad_accum",
+         "fault-injection"],
+)
+def test_precision_legal_pairs_build(trainer_kw):
+    _precision_trainer(_bf16_model(), mesh_of(dp=8), **trainer_kw)
+
+
+def test_precision_composes_with_health_guard():
+    from distributeddeeplearning_tpu.config import HealthConfig
+
+    _precision_trainer(
+        _bf16_model(), mesh_of(dp=8), health=HealthConfig(enabled=True)
+    )
+
+
+def test_precision_composes_with_remat():
+    _precision_trainer(_bf16_model(remat="full"), mesh_of(dp=8))
+
+
+def test_precision_rejects_pipelined_model():
+    mesh = mesh_of(dp=2, pp=2)
+    model = models.get_model(
+        "gpt2_pp", size="tiny", vocab_size=64, max_len=32,
+        num_stages=2, num_microbatches=2, mesh=mesh,
+    )
+    with pytest.raises(NotImplementedError, match="pipelined"):
+        _precision_trainer(model, mesh)
+
+
+def test_precision_rejects_model_dtype_mismatch():
+    # fp32 model + bf16 policy: the compute cast would silently do nothing
+    # the model honors — fail with the route (policy owns the dtype).
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=64, max_len=32, dropout_rate=0.0
+    )
+    with pytest.raises(ValueError, match="model.dtype"):
+        _precision_trainer(model, mesh_of(dp=8))
+
+
+@pytest.mark.parametrize(
+    "optim, match",
+    [
+        ("sgd", "optim.name='sgd'"),
+        ("adamw_fused", "adamw_fused"),
+    ],
+)
+def test_bf16_full_rejects_non_adamw_moments(optim, match):
+    with pytest.raises(ValueError, match=match):
+        make_optimizer(optim, 1e-3, precision="bf16_full")
+
+
+def test_bf16_policy_keeps_fused_adamw():
+    # Only bf16_full touches moment storage; plain bf16 must not lose the
+    # fused-kernel path.
+    make_optimizer("adamw_fused", 1e-3, precision="bf16")
+
+
+def test_unknown_policy_fails_by_name():
+    with pytest.raises(ValueError, match="train.precision.policy"):
+        make_optimizer("adamw", 1e-3, precision="fp8")
+
+
+def test_precision_config_block_rejects_scalar_override():
+    # `train.precision=bf16` is a likely typo for `.policy=` — it must not
+    # silently replace the block.
+    from distributeddeeplearning_tpu.config import apply_overrides, load_config
+
+    cfg = load_config("configs/gpt2_owt.py")
+    with pytest.raises(
+        ValueError, match=r"train\.precision is a config block"
+    ):
+        apply_overrides(cfg, ["train.precision=bf16"])
+
+
+def test_config_path_rejects_dtype_policy_conflict():
+    # gpt2_owt ships the legacy model.kwargs.dtype='bfloat16'; asking for a
+    # CONFLICTING policy through build_all must fail with the route out.
+    import os
+
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import apply_overrides, load_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = apply_overrides(
+        load_config(os.path.join(repo, "configs", "gpt2_owt.py")),
+        ['model.kwargs={"size":"tiny","max_len":32,"vocab_size":128,'
+         '"dtype":"float32"}',
+         "data.batch_size=8", "data.seq_len=16", "data.vocab_size=128",
+         "train.precision.policy=bf16", "optim.name=adamw"],
+    )
+    with pytest.raises(ValueError, match="the policy owns the compute dtype"):
+        build_all(cfg)

@@ -78,6 +78,19 @@ def test_resume_leg_reproduces_final_eval(record):
     ) < 0.005
 
 
+def test_precision_parity_recorded(record):
+    # Mixed-precision satellite (docs/MIXED_PRECISION.md): the tool's
+    # --precision-parity leg trains the tiny transformer under fp32 and
+    # bf16 on identical seeds/data and the final losses must agree within
+    # the committed tolerance — the convergence half of the bf16 claim
+    # (the byte half is HLO-asserted in test_precision.py).
+    pp = record["precision_parity"]
+    assert pp["parity_met"] is True
+    assert pp["loss_decreased_bf16"] is True
+    assert pp["final_loss_abs_gap"] <= pp["tolerance"] <= 0.1
+    assert pp["steps"] >= 60  # long enough for drift to show, if any
+
+
 def test_history_shows_learning(record):
     # Eval accuracy must RISE over the run (first eval vs final), and train
     # loss must fall — the artifact carries the full curve for the judge.

@@ -136,6 +136,41 @@ def test_eval_seed_selects_heldout_split(mesh8):
     assert not (ds_a.batch(0)["image"] == ds_b.batch(0)["image"]).all()
 
 
+def test_eval_accumulates_fp32_under_bf16_model(mesh8):
+    # Mixed-precision satellite (docs/MIXED_PRECISION.md): a bf16-compute
+    # model must not leak bf16 into metric accumulation — eval_step pins
+    # its outputs to fp32, and evaluate()'s on-device sums stay fp32, so a
+    # long eval pass cannot lose counts to bf16's 8-bit mantissa.
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=256, max_len=64, dropout_rate=0.0,
+        dtype=jnp.bfloat16,
+    )
+    trainer = Trainer(
+        model, make_optimizer("adamw", 1e-3, precision="bf16"),
+        get_task("lm"), mesh8, donate=False, precision="bf16",
+    )
+    ds = data_lib.SyntheticTokens(
+        batch_size=16, seq_len=32, vocab_size=256, seed=0, n_distinct=4
+    )
+    state = trainer.init(0, ds.batch(0))
+    batch = next(data_lib.sharded_batches(ds.iter_from(0), mesh8))
+    for v in jax.tree.leaves(trainer.eval_step(state, batch)):
+        if jnp.issubdtype(v.dtype, jnp.inexact):
+            assert v.dtype == jnp.float32, v.dtype
+    metrics = evaluate(
+        trainer, state,
+        data_lib.sharded_batches(itertools.islice(ds.iter_from(0), 4), mesh8),
+    )
+    import numpy as np
+
+    assert np.isfinite(metrics["eval_loss"])
+
+
 def test_evaluate_single_host_pull_per_pass(mesh8, monkeypatch):
     # Metric sums accumulate on device; the whole pass costs exactly ONE
     # jax.device_get, regardless of batch count (the old loop pulled

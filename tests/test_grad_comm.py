@@ -15,7 +15,7 @@ from distributeddeeplearning_tpu import data as data_lib
 from distributeddeeplearning_tpu import models
 from distributeddeeplearning_tpu.parallel.fsdp import grad_sync_bytes
 from distributeddeeplearning_tpu.train import (
-    Trainer, batch_sharding, get_task, make_optimizer,
+    Trainer, get_task, make_optimizer,
 )
 from distributeddeeplearning_tpu.utils.hlo import collective_bytes
 
@@ -145,35 +145,16 @@ def test_fp32_default_untouched_on_busy_mesh():
 
 
 def _compiled_step_text(mesh, **trainer_kw):
-    model = _tiny_model()
+    # Shared HLO-compile helper (helpers.compiled_step_text) so the
+    # precision tests reuse the same parser instead of a per-file copy.
     ds = data_lib.SyntheticTokens(
         batch_size=16, seq_len=32, vocab_size=64, seed=0
     )
-    trainer = _trainer(mesh, model=model, **trainer_kw)
-    trainer.setup(ds.batch(0))
-    bsh = batch_sharding(mesh)
-    abs_batch = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(
-            np.asarray(x).shape, np.asarray(x).dtype, sharding=bsh
-        ),
-        dict(ds.batch(0)),
-    )
-    lowered = trainer.train_step.lower(
-        trainer.abstract_state_with_shardings(), abs_batch
-    )
-    return lowered.compile().as_text()
+    trainer = _trainer(mesh, model=_tiny_model(), **trainer_kw)
+    return helpers.compiled_step_text(trainer, ds.batch(0), mesh)
 
 
-def _sync_wire_bytes(text, n):
-    """Ring-model per-member wire bytes of the dp-group collectives — the
-    same accounting tools/project_scaling.py reports per grad_comm mode."""
-    factors = {"all-reduce": 2 * (n - 1) / n, "collective-permute": 1.0}
-    total = 0.0
-    for kind, entries in collective_bytes(text, n).items():
-        for payload, group in entries:
-            if group >= n // 2:
-                total += factors.get(kind, (n - 1) / n) * payload
-    return total
+_sync_wire_bytes = helpers.sync_wire_bytes
 
 
 def test_int8_step_emits_compressed_permutes_and_cuts_sync_bytes():

@@ -83,6 +83,34 @@ def test_grad_comm_comparison_shows_int8_win(shrunk):
     assert 3.0 < rn["int8_reduction_vs_fp32"] < 4.5, rn
 
 
+def test_precision_rows_cover_every_policy(shrunk):
+    # Mixed-precision comparison (docs/MIXED_PRECISION.md): every scenario
+    # carries a per-policy block — measured per-member durable bytes from a
+    # real sharded init plus analytic ring-model sync bytes — or records
+    # the composition fence by name, never a silent omission.
+    for row in shrunk["scenarios"]:
+        pp = row["precision"]["per_policy"]
+        assert set(pp) == {"fp32", "bf16", "bf16_full"}
+        for pol in ("fp32", "bf16"):
+            assert pp[pol]["param_bytes_per_member"] > 0
+            assert pp[pol]["opt_state_bytes_per_member"] > 0
+            assert pp[pol]["grad_sync_wire_bytes_analytic"] > 0
+        # Grads travel in the compute dtype: the modeled sync payload
+        # halves under bf16 (both scenario configs sync grad_comm=fp32).
+        assert pp["fp32"]["grad_sync_wire_bytes_analytic"] == pytest.approx(
+            2 * pp["bf16"]["grad_sync_wire_bytes_analytic"], rel=0.01
+        )
+        assert "fenced" in pp["bf16_full"]
+    # Both shipped scenario optimizers fence bf16_full by name: low-precision
+    # moments are an Adam state layout (sgd) and the Pallas kernel's moment
+    # buffers are fp32 (adamw_fused).
+    scen = shrunk["scenarios"]
+    assert "sgd" in scen[0]["precision"]["per_policy"]["bf16_full"]["fenced"]
+    assert "adamw_fused" in (
+        scen[1]["precision"]["per_policy"]["bf16_full"]["fenced"]
+    )
+
+
 def test_dcn_projection_costs_more_than_ici(shrunk):
     for row in shrunk["scenarios"]:
         ici, dcn = row["projections"]

@@ -33,6 +33,11 @@ Usage:
     python tools/convergence_run.py              # both legs + write artifact
     python tools/convergence_run.py --steps 800  # different budget
     python tools/convergence_run.py --skip-ablation   # main leg only
+    python tools/convergence_run.py --precision bf16  # mixed-precision legs
+    python tools/convergence_run.py --precision-parity
+        # ONLY the short bf16-vs-fp32 parity check on the tiny transformer;
+        # merges a ``precision_parity`` block into the existing artifact
+        # without rerunning (or requiring) the main legs
 """
 
 from __future__ import annotations
@@ -132,10 +137,13 @@ def write_split(path: str, n: int, seed: int, label_noise: float = 0.0) -> str:
 
 
 def run(steps: int, out_dir: str, train_path: str, eval_path: str,
-        augment: bool = True, resume_leg: bool = True) -> dict:
+        augment: bool = True, resume_leg: bool = True,
+        precision: str = "fp32") -> dict:
     """One training leg over pre-generated split files. ``augment=False``
     is the ablation: identical data bytes, identical budget, in-loader
-    augmentation off — the recipe-sensitivity control."""
+    augmentation off — the recipe-sensitivity control. ``precision``
+    routes through ``train.precision.policy`` (docs/MIXED_PRECISION.md),
+    NOT ``model.kwargs.dtype``."""
     from distributeddeeplearning_tpu.cli import build_all, make_eval_fn
     from distributeddeeplearning_tpu.config import apply_overrides, load_config
     from distributeddeeplearning_tpu.data import prefetch, sharded_batches
@@ -165,6 +173,7 @@ def run(steps: int, out_dir: str, train_path: str, eval_path: str,
         'model.kwargs={"num_classes":10,"width":32,"stem":"cifar"}',
         "optim.lr=0.05",
         f"optim.warmup_steps={max(steps // 20, 1)}",
+        f"train.precision.policy={precision}",
     ]
     cfg = apply_overrides(
         load_config(os.path.join(_REPO, "configs", "resnet18_cifar10.py")),
@@ -207,6 +216,7 @@ def run(steps: int, out_dir: str, train_path: str, eval_path: str,
 
     record = {
         "augment": augment,
+        "precision": precision,
         "steps": cfg.train.steps,
         "global_batch": cfg.data.batch_size,
         "final_eval_accuracy": round(final_acc, 4),
@@ -235,13 +245,94 @@ def run(steps: int, out_dir: str, train_path: str, eval_path: str,
     return record
 
 
+def precision_parity(steps: int = 80) -> dict:
+    """Short bf16-vs-fp32 convergence parity on the tiny transformer:
+    identical seeds/data/optimizer, only ``train.precision`` differs. The
+    fp32-master design means bf16 jitters the trajectory (activation/grad
+    rounding) but must not bias it — final losses land within a small
+    absolute gap. Cheap enough to rerun on every precision-subsystem
+    change, unlike the main legs."""
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu import data as data_lib
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.mesh import MeshConfig, build_mesh
+    from distributeddeeplearning_tpu.train import (
+        Trainer, get_task, make_optimizer,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=-1))
+
+    def leg(policy: str) -> list[float]:
+        model_kw = dict(
+            size="tiny", vocab_size=256, max_len=64, dropout_rate=0.0
+        )
+        if policy != "fp32":
+            model_kw["dtype"] = jnp.bfloat16
+        model = models.get_model("gpt2", **model_kw)
+        ds = data_lib.SyntheticTokens(
+            batch_size=16, seq_len=32, vocab_size=256, seed=0, n_distinct=8
+        )
+        trainer = Trainer(
+            model, make_optimizer("adamw", 1e-3, precision=policy),
+            get_task("lm"), mesh, donate=False, precision=policy,
+        )
+        state = trainer.init(0, ds.batch(0))
+        losses = []
+        it = data_lib.sharded_batches(ds.iter_from(0), mesh)
+        for _ in range(steps):
+            state, m = trainer.train_step(state, next(it))
+            losses.append(float(m["loss"]))
+        return losses
+
+    fp32, bf16 = leg("fp32"), leg("bf16")
+    gap = abs(fp32[-1] - bf16[-1])
+    tolerance = 0.05
+    return {
+        "model": "gpt2 tiny (synthetic tokens, cpu-sim DP)",
+        "steps": steps,
+        "optimizer": "adamw lr=1e-3",
+        "final_loss_fp32": round(fp32[-1], 4),
+        "final_loss_bf16": round(bf16[-1], 4),
+        "final_loss_abs_gap": round(gap, 5),
+        "tolerance": tolerance,
+        "parity_met": bool(gap < tolerance),
+        "loss_decreased_bf16": bool(bf16[-1] < bf16[0]),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=600)  # ~37 epochs @ 2048
     ap.add_argument("--out-dir", default="/tmp/synthcifar")
     ap.add_argument("--skip-ablation", action="store_true",
                     help="main (augmented) leg only")
+    ap.add_argument("--precision", default="fp32",
+                    choices=["fp32", "bf16", "bf16_full"],
+                    help="train.precision.policy for the main legs "
+                         "(bf16_full needs optim.name=adamw; the shipped "
+                         "resnet recipe is sgd, which fails fast by name)")
+    ap.add_argument("--precision-parity", action="store_true",
+                    help="run ONLY the bf16-vs-fp32 tiny-transformer parity "
+                         "leg and merge it into the artifact")
+    ap.add_argument("--parity-steps", type=int, default=80)
     args = ap.parse_args()
+
+    if args.precision_parity:
+        parity = precision_parity(args.parity_steps)
+        merged = {}
+        if os.path.exists(ARTIFACT):
+            with open(ARTIFACT) as f:
+                merged = json.load(f)
+        merged["precision_parity"] = parity
+        with open(ARTIFACT + ".tmp", "w") as f:
+            json.dump(merged, f, indent=2)
+            f.write("\n")
+        os.replace(ARTIFACT + ".tmp", ARTIFACT)
+        print("PRECISION_PARITY", json.dumps(parity))
+        return 0 if parity["parity_met"] else 1
+
     os.makedirs(args.out_dir, exist_ok=True)
 
     train_path = os.path.join(args.out_dir, "synthcifar_train.bin")
@@ -253,7 +344,8 @@ def main() -> int:
     gen_s = round(time.time() - t0, 1)
 
     main_leg = run(args.steps, os.path.join(args.out_dir, "main"),
-                   train_path, eval_path, augment=True, resume_leg=True)
+                   train_path, eval_path, augment=True, resume_leg=True,
+                   precision=args.precision)
     record = {
         "task": "synthcifar-10 hardened (procedural; no real CIFAR-10 in "
                 "this environment — see module docstring)",
@@ -280,13 +372,24 @@ def main() -> int:
         # Must land measurably below the full recipe — the evidence that
         # the augmentation component is load-bearing, not decorative.
         ablation = run(args.steps, os.path.join(args.out_dir, "ablation"),
-                       train_path, eval_path, augment=False, resume_leg=False)
+                       train_path, eval_path, augment=False, resume_leg=False,
+                       precision=args.precision)
         ablation.pop("history")  # the main leg's curve is the committed one
         record["ablation"] = ablation
         record["ablation_gap"] = round(
             record["final_eval_accuracy"] - ablation["final_eval_accuracy"], 4
         )
 
+    # A full-legs rerun must not drop the (independently generated)
+    # precision_parity block from the committed artifact.
+    if os.path.exists(ARTIFACT):
+        try:
+            with open(ARTIFACT) as f:
+                prior = json.load(f)
+            if "precision_parity" in prior and "precision_parity" not in record:
+                record["precision_parity"] = prior["precision_parity"]
+        except (json.JSONDecodeError, OSError):
+            pass
     with open(ARTIFACT + ".tmp", "w") as f:
         json.dump(record, f, indent=2)
         f.write("\n")

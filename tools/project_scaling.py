@@ -218,6 +218,69 @@ def _compile_text(name: str, overrides: list) -> tuple[str, int]:
     return text, tree_bytes(state.params)
 
 
+def _precision_rows(name: str, overrides: list) -> dict:
+    """Per-policy durable-state + gradient-sync bytes for this scenario
+    (docs/MIXED_PRECISION.md). Each ``train.precision.policy`` either gets
+    a measured row — per-member param/opt-state bytes from a REAL sharded
+    init (``parallel.fsdp.per_device_bytes``) plus the analytic ring-model
+    wire bytes of one grad sync — or records the composition fence by name
+    (e.g. bf16_full x sgd / adamw_fused), never a silent omission. Wire
+    bytes are analytic here because the CPU post-opt HLO promotes bf16
+    all-reduces back to f32 (the honest 2x is HLO-asserted from the
+    post-SPMD-partitioner dump in tests/test_precision.py); durable bytes
+    are measured, not modeled. The fp32 row keeps each config's OWN
+    ``model.kwargs.dtype`` (both scenario configs ship bf16 params — the
+    legacy footgun path the policy replaces), so the fp32->bf16 delta here
+    shows the cost of gaining fp32 masters, and bf16_full the moment win."""
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import apply_overrides, load_config
+    from distributeddeeplearning_tpu.parallel.fsdp import (
+        grad_sync_bytes,
+        per_device_bytes,
+    )
+    from distributeddeeplearning_tpu.precision import POLICIES, get_policy
+
+    out: dict = {"per_policy": {}}
+    for pol in POLICIES:
+        try:
+            cfg = apply_overrides(
+                load_config(os.path.join(_REPO, "configs", f"{name}.py")),
+                overrides + [f"train.precision.policy={pol}"],
+            )
+            mesh, _, trainer, dataset = build_all(cfg)
+            state = trainer.init(cfg.train.seed, dataset.batch(0))
+        except (ValueError, NotImplementedError) as e:
+            out["per_policy"][pol] = {"fenced": f"{e}"[:200]}
+            continue
+        p = get_policy(pol)
+        out["per_policy"][pol] = {
+            "param_bytes_per_member": per_device_bytes(state.params),
+            "opt_state_bytes_per_member": per_device_bytes(state.opt_state),
+            "grad_sync_wire_bytes_analytic": grad_sync_bytes(
+                state.params,
+                mode=cfg.train.grad_comm,
+                block_size=cfg.train.grad_comm_block,
+                n_members=mesh.shape["dp"],
+                wire_elem_bytes=(
+                    p.compute_dtype.itemsize if p.mixed else None
+                ),
+            ),
+        }
+        del state
+    rows = out["per_policy"]
+
+    def _state(pol):
+        r = rows.get(pol, {})
+        if "fenced" in r:
+            return None
+        return r["param_bytes_per_member"] + r["opt_state_bytes_per_member"]
+
+    base, full = _state("fp32"), _state("bf16_full")
+    if base and full:
+        out["state_bytes_fp32_over_bf16_full"] = round(base / full, 2)
+    return out
+
+
 def main() -> int:
     import jax
 
@@ -299,6 +362,7 @@ def main() -> int:
             "config": name,
             "params_bytes": params_bytes,
             "grad_comm": grad_comm,
+            "precision": _precision_rows(name, overrides),
             "sync_payload_bytes_by_kind": {
                 k: v for k, v in sync.items() if v
             },
