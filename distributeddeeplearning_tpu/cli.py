@@ -288,6 +288,71 @@ def cmd_generate(cfg: Config, prompts: list[str], max_new_tokens: int,
     return 0
 
 
+def cmd_serve(cfg: Config, prompts: list[str], max_new_tokens: int,
+              temperature: float, seed: int, *, top_k: int = 0,
+              top_p: float = 0.0) -> int:
+    """Serve a batch of prompts through the continuous-batching engine
+    (``serving/``; docs/SERVING.md): paged KV cache, AOT prefill/decode,
+    requests joining and leaving the decode batch mid-flight. Same byte
+    tokenizer contract as ``generate``; the ``serving`` config section
+    sizes the engine. Emits one JSON record with completions, per-request
+    latency metrics, engine stats, and the lifecycle event stream."""
+    import numpy as np
+
+    from .serving import Request, ServingEngine, check_serving_composition
+
+    # Composition fences FIRST (fail by name before any build/restore).
+    check_serving_composition(cfg)
+    if any(not p for p in prompts):
+        raise ValueError("prompt must be non-empty")
+    if temperature == 0.0 and (top_k or top_p):
+        raise ValueError(
+            "--top-k/--top-p only apply when sampling — set --temperature"
+        )
+    mesh, model, trainer, dataset = build_all(cfg)
+    vocab = getattr(model, "vocab_size", 0)
+    if vocab != 256:
+        raise ValueError(
+            f"cli serve requires a byte-tokenizer model (vocab_size=256, "
+            f"got {vocab}): prompts are encoded as UTF-8 bytes and "
+            "completions decoded back (prepare_data --tokenizer byte). "
+            "Use serving.ServingEngine directly for other tokenizers."
+        )
+    state = _restore_or_init(cfg, trainer, dataset.batch(0), "serving from")
+    # Serving decodes through the xla core on one program (the engine
+    # re-fences this; clone here mirrors cmd_generate).
+    updates = {}
+    if hasattr(model, "attn_impl"):
+        updates["attn_impl"] = "xla"
+    if hasattr(model, "mesh") and model.mesh is not None:
+        updates["mesh"] = None
+    if updates:
+        model = model.clone(**updates)
+    engine = ServingEngine(model, state.params, cfg.serving, seed=seed)
+    engine.warmup()
+    for p in prompts:
+        engine.submit(Request(
+            prompt=list(p.encode("utf-8")), max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        ))
+    finished = engine.run()
+    results = []
+    for st in finished:
+        m = st.metrics()
+        m["prompt"] = bytes(st.request.prompt).decode("utf-8", "replace")
+        m["completion"] = bytes(
+            t for t in st.generated if 0 <= t < 256
+        ).decode("utf-8", errors="replace")
+        results.append(m)
+    print(json.dumps({
+        "step": int(state.step),
+        "results": results,
+        "stats": engine.stats(),
+        "events": engine.events,
+    }))
+    return 0
+
+
 def _train_once(cfg: Config, fault) -> int:
     """One training attempt: build, restore-or-init, fit. Raises
     ``train.Preempted`` / ``train.HealthRollback`` for ``cmd_train``'s outer
@@ -459,7 +524,8 @@ def cmd_supervise(args) -> int:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="distributeddeeplearning_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("train", "eval", "benchmark", "generate", "supervise"):
+    for name in ("train", "eval", "benchmark", "generate", "serve",
+                 "supervise"):
         p = sub.add_parser(name)
         p.add_argument("--config", required=True, help="path to a config .py")
         p.add_argument(
@@ -475,17 +541,19 @@ def main(argv=None) -> int:
             help="apply mesh.XLA_PERF_FLAGS (async-collective overlap) "
             "before backend init",
         )
-        if name == "generate":
+        if name in ("generate", "serve"):
             p.add_argument(
                 "--prompt", required=True, action="append",
                 help="repeatable: a batch of (uneven) prompts decodes "
-                "together via left padding",
+                "together (generate: left padding; serve: continuous "
+                "batching over serving.slots lanes)",
             )
             p.add_argument("--max-new-tokens", type=int, default=64)
             p.add_argument("--temperature", type=float, default=0.0)
             p.add_argument("--top-k", type=int, default=0)
             p.add_argument("--top-p", type=float, default=0.0)
             p.add_argument("--seed", type=int, default=0)
+        if name == "generate":
             p.add_argument(
                 "--bench", action="store_true",
                 help="re-run the compiled decode loop once and report "
@@ -516,6 +584,11 @@ def main(argv=None) -> int:
         return cmd_generate(
             cfg, args.prompt, args.max_new_tokens, args.temperature,
             args.seed, top_k=args.top_k, top_p=args.top_p, bench=args.bench,
+        )
+    if args.cmd == "serve":
+        return cmd_serve(
+            cfg, args.prompt, args.max_new_tokens, args.temperature,
+            args.seed, top_k=args.top_k, top_p=args.top_p,
         )
     if args.cmd == "benchmark":
         try:

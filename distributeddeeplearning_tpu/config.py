@@ -268,6 +268,37 @@ class SupervisorConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Serving engine (``serving/``; ``serve`` CLI subcommand): continuous
+    batching over a paged KV cache with AOT prefill/decode programs. See
+    docs/SERVING.md for the sizing math behind these knobs."""
+
+    # Decode lanes: the decode program's fixed batch size. More lanes =
+    # more throughput until the pool or the matmul saturates, at the cost
+    # of per-token latency (docs/TUNING.md).
+    slots: int = 4
+    # KV tokens per pool block. Small blocks waste less pool on the last
+    # partial block per sequence but grow the page table.
+    block_size: int = 16
+    # HBM budget for the KV pool (all layers together); the engine derives
+    # num_blocks from it via a shape probe of the actual model.
+    hbm_budget_mb: int = 128
+    # Hard cap on prompt + generated tokens per request. 0 = model max_len.
+    max_seq_len: int = 0
+    # Prefill shape buckets: a prompt is right-padded to the smallest
+    # bucket that fits, so there is one compiled prefill per bucket and
+    # steady state never recompiles. Must be strictly increasing and leave
+    # room for generation under max_seq_len.
+    prompt_buckets: tuple = (32, 128, 512)
+    # "int8": block-quantized weights (serving/quant.py), dequant-on-use.
+    quant: str = "none"
+    quant_block: int = 256
+    # Stop decoding a request when it emits this token (-1 = run to
+    # max_new_tokens; byte-tokenizer CLI serving has no EOS).
+    eos_id: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
@@ -278,6 +309,7 @@ class Config:
     supervisor: SupervisorConfig = dataclasses.field(
         default_factory=SupervisorConfig
     )
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, default=str)

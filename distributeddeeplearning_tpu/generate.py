@@ -48,28 +48,73 @@ def _filter_logits(logits, top_k, top_p):
     """Standard top-k + nucleus (top-p) filtering, [B, V] -> [B, V] with
     excluded entries at -inf. Expects TEMPERED logits (the caller divides
     by temperature first — HF's warper order, so the nucleus shrinks as
-    temperature sharpens). Both knobs are TRACED scalars (0 = off), sharing
-    one descending sort, so sweeping them never recompiles."""
-    V = logits.shape[-1]
+    temperature sharpens). Both knobs are TRACED operands (0 = off) —
+    scalars (generate: one setting per batch) or [B] vectors (serving
+    engine: per-request sampling params in one decode batch) — sharing one
+    descending sort, so sweeping them never recompiles."""
+    B, V = logits.shape
     sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
     # top-k threshold: the kth-largest logit (clamped into [1, V] so an
     # oversized k degrades to no-op instead of crashing).
-    k = jnp.clip(top_k, 0, V)
-    kth = jax.lax.dynamic_slice_in_dim(
-        sorted_desc, jnp.maximum(k - 1, 0), 1, axis=1
-    )
+    k = jnp.clip(jnp.broadcast_to(top_k, (B,)), 0, V)[:, None]
+    kth = jnp.take_along_axis(sorted_desc, jnp.maximum(k - 1, 0), axis=-1)
     thresh_k = jnp.where(k > 0, kth, -jnp.inf)
     # nucleus threshold: smallest logit of the minimal prefix whose
     # cumulative probability reaches top_p (first token always kept).
+    p = jnp.broadcast_to(top_p, (B,))[:, None]
     probs = jax.nn.softmax(sorted_desc, axis=-1)
-    keep_sorted = jnp.cumsum(probs, axis=-1) - probs < top_p
+    keep_sorted = jnp.cumsum(probs, axis=-1) - probs < p
     thresh_p = jnp.min(
         jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True
     )
-    thresh_p = jnp.where(top_p > 0, thresh_p, -jnp.inf)
+    thresh_p = jnp.where(p > 0, thresh_p, -jnp.inf)
     return jnp.where(
         logits < jnp.maximum(thresh_k, thresh_p), -jnp.inf, logits
     )
+
+
+def prefill(model, params, cache, tokens):
+    """THE prefill body: run ``tokens`` [B, P] through a decode-mode model
+    against ``cache`` (bulk KV write — decode_attention's L>1 path, or the
+    paged-pool write for a ``kv_pages`` model). Returns ``(out, cache')``
+    where ``out`` is the model's raw output (logits or chunked head).
+
+    Shared by :func:`generate`'s fused program and the serving engine's
+    per-bucket prefill graphs (serving/engine.py) — one KV/attention body,
+    no serving-side duplicate."""
+    out, vars_ = model.apply(
+        {"params": params, "cache": cache}, tokens, mutable=["cache"]
+    )
+    return out, vars_["cache"]
+
+
+def decode_step(model, params, cache, tok):
+    """THE one-token decode body: ``tok`` [B, 1] -> ``(logits [B, V] at the
+    new position, cache')``. Shared by :func:`generate`'s decode scan and
+    the serving engine's continuous-batching decode graph."""
+    out, cache = prefill(model, params, cache, tok)
+    return _logits_of(out)[:, -1, :], cache
+
+
+def logits_at(out, pos):
+    """Model output -> [B, V] logits at per-row position ``pos`` [B]
+    (traced). The serving engine samples the first token of a RIGHT-padded
+    bucketed prompt from position ``len-1``, not ``-1``; for chunked-head
+    models the hidden row is sliced BEFORE the head einsum so the [B, P, V]
+    logits never materialize."""
+    from .ops.chunked_xent import is_chunked_head
+
+    idx = pos[:, None, None]
+    if is_chunked_head(out):
+        hidden = jnp.take_along_axis(
+            out["hidden"], jnp.broadcast_to(
+                idx, (out["hidden"].shape[0], 1, out["hidden"].shape[-1])
+            ), axis=1,
+        )
+        return _logits_of(dict(out, hidden=hidden))[:, -1, :]
+    return jnp.take_along_axis(
+        out, jnp.broadcast_to(idx, (out.shape[0], 1, out.shape[-1])), axis=1
+    )[:, -1, :].astype(jnp.float32)
 
 
 def _make_pick(temperature, top_k, top_p, sample, filtered):
@@ -121,10 +166,7 @@ def _prefill_body(model, params, prompt, rng, temperature, top_k, top_p,
         # TPU prefill/decode split.
         from .ops.chunked_xent import is_chunked_head
 
-        out, vars_ = model.apply(
-            {"params": params, "cache": cache}, prompt.astype(jnp.int32),
-            mutable=["cache"],
-        )
+        out, cache = prefill(model, params, cache, prompt.astype(jnp.int32))
         if is_chunked_head(out):
             # Only the last position feeds sampling — slice the hidden
             # BEFORE the head einsum would materialize [B, P, V] logits.
@@ -133,7 +175,6 @@ def _prefill_body(model, params, prompt, rng, temperature, top_k, top_p,
         buf = lax.dynamic_update_slice(
             buf, first.astype(jnp.int32)[:, None], (0, P)
         )
-        cache = vars_["cache"]
     # else: one-token prefill (capacity-MoE models: a bulk prefill routes
     # the whole prompt through expert capacity at once and may drop tokens
     # a one-token stream would keep, changing decode numerics) — the scan
@@ -151,17 +192,15 @@ def _decode_body(model, params, buf, cache, rng, temperature, top_k, top_p,
     def step(carry, i):
         buf, cache, rng = carry
         tok = lax.dynamic_slice(buf, (0, i), (B, 1))
-        out, vars_ = model.apply(
-            {"params": params, "cache": cache}, tok, mutable=["cache"]
-        )
-        nxt, rng = pick(_logits_of(out)[:, -1, :], rng)
+        logits, cache = decode_step(model, params, cache, tok)
+        nxt, rng = pick(logits, rng)
         # Positions < P-1 keep the prompt token already in the buffer;
         # the model still consumed tok so its KV cache covers the prefix.
         keep_prompt = (i + 1) < P
         cur = lax.dynamic_slice(buf, (0, i + 1), (B, 1))[:, 0]
         nxt = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, i + 1))
-        return (buf, vars_["cache"], rng), None
+        return (buf, cache, rng), None
 
     (buf, _, _), _ = lax.scan(
         step, (buf, cache, rng), jnp.arange(loop_start, total - 1)

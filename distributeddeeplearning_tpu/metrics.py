@@ -21,6 +21,24 @@ def event_record(name: str, step: int, **fields) -> dict:
     return {"event": name, "step": step, **fields}
 
 
+# Serving lifecycle events (serving/engine.py) — same record shape as the
+# training loop's events so one stream consumer handles both. "step" is the
+# engine's step counter (one decode iteration), not a training step.
+SERVING_EVENTS = ("request_admitted", "first_token", "request_completed")
+
+
+def serving_event(name: str, step: int, *, request_id: int, **fields) -> dict:
+    """A serving lifecycle event as a metrics-stream record. ``name`` must
+    be one of :data:`SERVING_EVENTS`; every record carries the request id
+    so per-request traces can be reassembled from the flat stream."""
+    if name not in SERVING_EVENTS:
+        raise ValueError(
+            f"unknown serving event {name!r} (expected one of "
+            f"{SERVING_EVENTS})"
+        )
+    return event_record(name, step, request_id=request_id, **fields)
+
+
 class DeferredMetrics:
     """One-interval-lag metric fetch: the non-blocking logging path.
 

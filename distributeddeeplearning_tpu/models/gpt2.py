@@ -40,6 +40,10 @@ class GPT2(nn.Module):
     # KV-cache autoregressive decoding (generate.py): init with the full
     # generation budget to shape the caches, then feed one token per call.
     decode: bool = False
+    # Paged serving cache (serving/engine.py): (num_blocks, block_size,
+    # pages_per_seq) — per-row cursors, block-pool KV storage
+    # (transformer.paged_decode_attention). Requires decode=True.
+    kv_pages: tuple | None = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -65,7 +69,25 @@ class GPT2(nn.Module):
             ),
             name="wpe",
         )
-        if self.decode:
+        if self.decode and self.kv_pages is not None:
+            # Paged serving: per-ROW position cursors — rows decode at
+            # different depths in one batch (continuous batching). The leaf
+            # name 'seq_lens' matches the per-layer attention cursors so the
+            # serving engine injects one [B] array everywhere by name.
+            lens = self.variable(
+                "cache", "seq_lens", lambda: jnp.zeros((B,), jnp.int32)
+            )
+            if self.is_initializing():
+                positions = jnp.arange(L)[None, :]
+            else:
+                # Clamp: pad positions of a bucketed prefill may exceed
+                # max_len - 1; their embeddings feed only discarded outputs.
+                positions = jnp.minimum(
+                    lens.value[:, None] + jnp.arange(L)[None, :],
+                    self.max_len - 1,
+                )
+                lens.value = lens.value + L
+        elif self.decode:
             # Position cursor for the cache-decoding path (the attention
             # cursors live per-layer; this one feeds wpe). 'start' ([B],
             # left-pad counts, default 0) keeps a left-padded row's first
@@ -106,6 +128,7 @@ class GPT2(nn.Module):
             attn_impl=self.attn_impl,
             mesh=self.mesh,
             decode=self.decode,
+            kv_pages=self.kv_pages,
             name="h",
         )(x, None, not train)
         x = layer_norm(1e-5, self.dtype, "ln_f")(x)

@@ -35,7 +35,12 @@ from flax import linen as nn
 from . import register
 from ..comms import identity_fwd_psum_bwd, psum_identity_bwd
 from ..sharding import constrain
-from .transformer import attention_core, decode_attention, dense_init
+from .transformer import (
+    attention_core,
+    decode_attention,
+    dense_init,
+    paged_decode_attention,
+)
 
 
 class RMSNorm(nn.Module):
@@ -100,6 +105,9 @@ class LlamaAttention(nn.Module):
     psum_axis: str | None = None
     manual_tp_ad: bool = False  # see transformer.SelfAttention.manual_tp_ad
     decode: bool = False  # KV-cache decoding (transformer.decode_attention)
+    # Paged serving cache (transformer.paged_decode_attention): per-row
+    # cursors + block-pool KV storage. Requires decode=True.
+    kv_pages: tuple | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -132,7 +140,17 @@ class LlamaAttention(nn.Module):
         positions = jnp.arange(L)
         idx_var = None
         start_var = None
-        if self.decode:
+        lens_var = None
+        if self.decode and self.kv_pages is not None:
+            # Paged serving: per-ROW RoPE positions from the per-row cursor
+            # (registered here so RoPE sees it BEFORE paged_decode_attention
+            # advances it). Serving rows are never left-padded — no 'start'.
+            lens_var = self.variable(
+                "cache", "seq_lens", lambda: jnp.zeros((B,), jnp.int32)
+            )
+            if not self.is_initializing():
+                positions = lens_var.value[:, None] + positions[None, :]
+        elif self.decode:
             # RoPE at the cache cursor; the variables are registered ONCE
             # here and passed into decode_attention (which advances idx).
             idx_var = self.variable(
@@ -159,7 +177,17 @@ class LlamaAttention(nn.Module):
         # Decode caches the PRE-repeat kv (num_kv_heads slabs — GQA's cache
         # memory benefit, ADVICE r3 #4) and repeats per step at use.
         rep = self.num_heads // self.num_kv_heads
-        if self.decode:
+        if self.decode and self.kv_pages is not None:
+            if self.attn_impl != "xla":
+                raise NotImplementedError(
+                    "paged decode supports attn_impl='xla' only, got "
+                    f"{self.attn_impl!r}"
+                )
+            out = paged_decode_attention(
+                self, q, k, v, dtype=self.dtype, kv_pages=self.kv_pages,
+                num_rep=rep, lens_var=lens_var,
+            )
+        elif self.decode:
             out = decode_attention(
                 self, q, k, v, dtype=self.dtype, attn_impl=self.attn_impl,
                 idx_var=idx_var, num_rep=rep, start_var=start_var,
@@ -267,6 +295,7 @@ class LlamaBlock(nn.Module):
     # per-device arrays, where global sharding constraints don't apply.
     constrain_out: bool = True
     decode: bool = False  # KV-cache decoding
+    kv_pages: tuple | None = None  # paged serving cache (LlamaAttention)
 
     @nn.compact
     def __call__(self, x):
@@ -275,7 +304,7 @@ class LlamaBlock(nn.Module):
             rope_theta=self.rope_theta, dtype=self.dtype,
             attn_impl=self.attn_impl, mesh=self.mesh,
             psum_axis=self.psum_axis, manual_tp_ad=self.manual_tp_ad,
-            decode=self.decode, name="attn",
+            decode=self.decode, kv_pages=self.kv_pages, name="attn",
         )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
         if self.constrain_out:
             x = constrain(x, "batch", "seq", "embed")
@@ -304,6 +333,9 @@ class Llama(nn.Module):
     # KV-cache autoregressive decoding (generate.py): init with the full
     # generation budget to shape the caches, then feed one token per call.
     decode: bool = False
+    # Paged serving cache (serving/engine.py): per-row cursors + block-pool
+    # KV storage (transformer.paged_decode_attention). Requires decode=True.
+    kv_pages: tuple | None = None
     # True: the LM head shares the embedding table (Llama-3.2-class small
     # checkpoints; HF tie_word_embeddings) — no separate lm_head param.
     tie_embeddings: bool = False
@@ -333,7 +365,8 @@ class Llama(nn.Module):
                 self.embed_dim // self.num_heads, self.mlp_dim,
                 rope_theta=self.rope_theta, rms_eps=self.rms_eps,
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
-                decode=self.decode, name=f"block_{i}",
+                decode=self.decode, kv_pages=self.kv_pages,
+                name=f"block_{i}",
             )(x)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)
         decoder_ve = decoder_matrix(

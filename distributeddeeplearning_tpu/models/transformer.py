@@ -98,6 +98,106 @@ def attention_core(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _cache_attend(q, ck, cv, visible, num_rep: int, dtype):
+    """Attend q [B, L, H, D] against a full cached k/v [B, K, kv_heads, D]
+    under a [B, L, K] visibility mask — the ONE cached-attention core shared
+    by the contiguous decode cache and the paged serving cache.
+
+    ``num_rep > 1`` (GQA): contract each query-head group directly against
+    the UN-repeated cache — materializing a repeated cache every step would
+    transiently re-spend the exact HBM the pre-repeat cache saves. Same math
+    as the xla core on repeated heads (repeat is group-major: query head
+    g*rep+r reads kv group g).
+    """
+    B, L, H, D = q.shape
+    if num_rep > 1:
+        kv_heads = ck.shape[2]
+        qg = q.reshape(B, L, kv_heads, num_rep, D)
+        scores = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, ck
+        ).astype(jnp.float32) / np.sqrt(D)
+        scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv)
+        return out.reshape(B, L, H, D)
+    return attention_core(
+        q, ck, cv, impl="xla", causal=False,
+        dtype=dtype, mask=visible[:, None, :, :],
+    )
+
+
+def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
+                           num_rep: int = 1, lens_var=None):
+    """Decode/prefill attention against a PAGED KV cache (serving engine).
+
+    Instead of one contiguous [B, max_len] cache per sequence, k/v live in a
+    fixed **block pool** shared by all in-flight sequences — ``kv_pages =
+    (num_blocks, block_size, pages_per_seq)``:
+
+    - ``pool_key`` / ``pool_value``: [num_blocks, block_size, kv_heads, D],
+      one pool per layer, batch-independent — the SAME pool arrays serve the
+      B=1 prefill graph and the B=slots decode graph;
+    - ``page_table``: [B, pages_per_seq] int32 — row b's logical block j
+      lives in physical pool block ``page_table[b, j]`` (allocation is
+      host-side: serving/scheduler.KVBlockPool);
+    - ``seq_lens``: [B] int32 — tokens already cached per row. This call's L
+      tokens occupy logical positions ``seq_lens[b] .. seq_lens[b]+L-1``
+      (per-ROW cursors, unlike the contiguous path's shared scalar — rows at
+      different depths decode in one batch: continuous batching).
+
+    The serving engine reserves pool block 0 as a NULL block: idle slots
+    point their whole page table at it and keep ``seq_lens = 0``, so their
+    (garbage) writes land harmlessly in block 0 and their attention output
+    is discarded host-side.
+
+    L == 1 is one decode step; L > 1 is bulk prefill (positions beyond the
+    prompt's real length write pad KV into the row's own reserved pages and
+    are overwritten by real decode tokens later; causal masking hides them
+    from every real query). The gather materializes [B, pages*bs] per layer
+    — the CPU-sim reference lowering; a Pallas paged-attention kernel
+    (ops/, roadmap) replaces it on chip.
+    """
+    num_blocks, bs, pages = kv_pages
+    B, L, Hkv, D = k.shape
+    pk = module.variable(
+        "cache", "pool_key", jnp.zeros, (num_blocks, bs, Hkv, D), k.dtype
+    )
+    pv = module.variable(
+        "cache", "pool_value", jnp.zeros, (num_blocks, bs, Hkv, D), v.dtype
+    )
+    table = module.variable(
+        "cache", "page_table", lambda: jnp.zeros((B, pages), jnp.int32)
+    )
+    lens = lens_var if lens_var is not None else module.variable(
+        "cache", "seq_lens", lambda: jnp.zeros((B,), jnp.int32)
+    )
+    if module.is_initializing():
+        # Shape-only pass: create the pool and run plain causal attention.
+        def rep(t):
+            return jnp.repeat(t, num_rep, axis=2) if num_rep > 1 else t
+
+        return attention_core(
+            q, rep(k), rep(v), impl="xla", causal=True, dtype=dtype
+        )
+    pos = lens.value[:, None] + jnp.arange(L)[None, :]  # [B, L] absolute
+    blk = jnp.take_along_axis(table.value, pos // bs, axis=1)
+    flat = (blk * bs + pos % bs).reshape(-1)
+    pk.value = pk.value.reshape(num_blocks * bs, Hkv, D).at[flat].set(
+        k.reshape(B * L, Hkv, D)
+    ).reshape(pk.value.shape)
+    pv.value = pv.value.reshape(num_blocks * bs, Hkv, D).at[flat].set(
+        v.reshape(B * L, Hkv, D)
+    ).reshape(pv.value.shape)
+    # Gather each row's pages into logical order: [B, pages*bs, Hkv, D].
+    ck = pk.value[table.value].reshape(B, pages * bs, Hkv, D)
+    cv = pv.value[table.value].reshape(B, pages * bs, Hkv, D)
+    cols = jnp.arange(pages * bs)
+    visible = cols[None, None, :] <= pos[:, :, None]  # causal within the row
+    out = _cache_attend(q, ck, cv, visible, num_rep, dtype)
+    lens.value = lens.value + L
+    return out
+
+
 def decode_attention(module, q, k, v, *, dtype, attn_impl="xla",
                      idx_var=None, num_rep: int = 1, start_var=None):
     """One autoregressive decode step against a KV cache (used by
@@ -161,26 +261,7 @@ def decode_attention(module, q, k, v, *, dtype, attn_impl="xla",
         (cols[None, None, :] <= qpos[None, :, None])
         & (cols[None, None, :] >= start.value[:, None, None])
     )
-    if num_rep > 1:
-        # Grouped-head core: contract each query-head group directly
-        # against the UN-repeated cache — materializing rep(ck.value) every
-        # step would transiently re-spend the exact HBM the pre-repeat
-        # cache saves. Same math as the xla core on repeated heads (repeat
-        # is group-major: query head g*rep+r reads kv group g).
-        kv_heads = ck.value.shape[2]
-        qg = q.reshape(B, L, kv_heads, num_rep, D)
-        scores = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", qg, ck.value
-        ).astype(jnp.float32) / np.sqrt(D)
-        scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv.value)
-        out = out.reshape(B, L, H, D)
-    else:
-        out = attention_core(
-            q, ck.value, cv.value, impl="xla", causal=False,
-            dtype=dtype, mask=visible[:, None, :, :],
-        )
+    out = _cache_attend(q, ck.value, cv.value, visible, num_rep, dtype)
     idx.value = idx.value + L
     return out
 
@@ -233,6 +314,11 @@ class SelfAttention(nn.Module):
     # The init call (any length) only shapes the cache; real calls then
     # feed ONE token at a time. attn_impl='xla' only.
     decode: bool = False
+    # Serving engine (serving/engine.py): with decode=True, a non-None
+    # (num_blocks, block_size, pages_per_seq) switches the cache to the
+    # PAGED block-pool layout with per-row cursors (paged_decode_attention)
+    # instead of the contiguous per-sequence cache.
+    kv_pages: tuple | None = None
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -263,8 +349,18 @@ class SelfAttention(nn.Module):
                     "decode ignores key-padding masks — pad-free prompts "
                     "only (the cache visibility mask is cursor-based)"
                 )
-            out = decode_attention(self, q, k, v, dtype=self.dtype,
-                                   attn_impl=self.attn_impl)
+            if self.kv_pages is not None:
+                if self.attn_impl != "xla":
+                    raise NotImplementedError(
+                        "paged decode supports attn_impl='xla' only, got "
+                        f"{self.attn_impl!r}"
+                    )
+                out = paged_decode_attention(
+                    self, q, k, v, dtype=self.dtype, kv_pages=self.kv_pages
+                )
+            else:
+                out = decode_attention(self, q, k, v, dtype=self.dtype,
+                                       attn_impl=self.attn_impl)
         elif self.attn_impl == "flash":
             if self.dropout_rate and not deterministic:
                 raise NotImplementedError(
@@ -425,6 +521,7 @@ class TransformerBlock(nn.Module):
     psum_axis: str | None = None
     manual_tp_ad: bool = False  # see SelfAttention.manual_tp_ad
     decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
+    kv_pages: tuple | None = None  # paged serving cache (SelfAttention)
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -440,6 +537,7 @@ class TransformerBlock(nn.Module):
             psum_axis=self.psum_axis,
             manual_tp_ad=self.manual_tp_ad,
             decode=self.decode,
+            kv_pages=self.kv_pages,
             name="attn",
         )
         mlp = Mlp(
@@ -485,6 +583,7 @@ class TransformerStack(nn.Module):
     attn_impl: str = "xla"
     mesh: object = None
     decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
+    kv_pages: tuple | None = None  # paged serving cache (SelfAttention)
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -512,6 +611,7 @@ class TransformerStack(nn.Module):
                 attn_impl=self.attn_impl,
                 mesh=self.mesh,
                 decode=self.decode,
+                kv_pages=self.kv_pages,
                 name=f"block_{i}",
             )(x, mask, deterministic)
         return x

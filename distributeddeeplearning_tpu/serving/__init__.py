@@ -1,0 +1,24 @@
+"""TPU-native serving: continuous batching over a paged KV cache with
+AOT-compiled prefill/decode programs. See docs/SERVING.md.
+
+Host-side state (scheduler, block pool) and device-side programs (engine)
+are split so admission policy is unit-testable without a device.
+"""
+
+from .engine import (  # noqa: F401
+    SERVABLE_MODELS,
+    ServingEngine,
+    check_serving_composition,
+)
+from .quant import (  # noqa: F401
+    dequantize_params,
+    quantization_error,
+    quantize_params,
+)
+from .scheduler import (  # noqa: F401
+    KVBlockPool,
+    Request,
+    RequestState,
+    Scheduler,
+    blocks_for,
+)

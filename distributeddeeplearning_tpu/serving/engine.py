@@ -1,0 +1,473 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The TPU-native split (docs/SERVING.md): generation is TWO compiled
+programs, not one fused loop like :func:`generate.generate`:
+
+- **prefill** — one B=1 forward over the whole (bucket-padded) prompt:
+  writes the prompt's KV into the request's pool pages and samples the
+  first token from the real last position (``generate.logits_at``).
+  Compiled once per PROMPT BUCKET — prompts are right-padded up to the
+  smallest configured bucket that fits, so any prompt length hits an
+  existing executable.
+- **decode** — one token for the WHOLE in-flight batch per call, B =
+  ``serving.slots`` always (idle lanes ride along pointed at the null
+  block). One shape forever → compiled exactly once.
+
+Both are AOT-compiled (``jax.jit(...).lower(...).compile()``), so
+steady-state serving executes cached executables only — the engine counts
+compilations (``num_compiles``) and tests pin the count: admitting,
+finishing, and re-admitting requests of any mix of lengths never triggers
+a recompile.
+
+The KV pool arrays are batch-independent (``transformer.
+paged_decode_attention``), so the SAME pool serves both programs: the
+prefill cache argument is the decode cache with its ``page_table`` /
+``seq_lens`` leaves swapped for B=1 host arrays, and the updated pool
+leaves are folded back afterwards. The HOST is the source of truth for
+page tables and sequence lengths — they are rebuilt from scheduler state
+and injected by leaf name into the cache pytree before every call, so the
+device-side cursor copies are write-only.
+
+Sampling is per-REQUEST inside the compiled graphs: temperature / top_k /
+top_p ride as [B] operands through the per-row ``generate._filter_logits``
+(0 = off / greedy), and each lane carries its own PRNG key chain
+(``fold_in(seed, request_id)``), so one decode batch can mix greedy and
+sampled requests and a request's tokens do not depend on its batchmates.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..generate import _filter_logits, logits_at, prefill, decode_step
+from ..metrics import serving_event
+from .quant import dequantize_params, quantization_error, quantize_params
+from .scheduler import KVBlockPool, Request, RequestState, Scheduler, blocks_for
+
+_POOL_LEAVES = ("pool_key", "pool_value")
+_HOST_LEAVES = ("page_table", "seq_lens")
+
+# Models validated for paged-cache serving. Everything else is fenced at
+# config time (check_serving_composition) rather than failing deep inside
+# a trace: capacity-MoE decode routes through expert capacity (one-token
+# streams and batched prefills disagree — generate.uses_bulk_prefill),
+# and pipelined models own their own step program.
+SERVABLE_MODELS = ("gpt2", "llama")
+
+
+def check_serving_composition(cfg) -> None:
+    """Config-time composition fences for ``serve`` (PR-5 style: fail BY
+    NAME before any compile). ``cfg`` is the full Config."""
+    name = cfg.model.name
+    if name.endswith("_pp"):
+        raise NotImplementedError(
+            f"serving x pipelined model ({name!r}): the pipeline engine "
+            "owns its own step program and has no decode path — serve the "
+            "equivalent dense model"
+        )
+    if name in ("gpt2_moe", "llama_moe"):
+        raise NotImplementedError(
+            f"serving x capacity-MoE ({name!r}): batched paged prefill "
+            "routes the whole prompt through expert capacity at once and "
+            "can drop tokens a one-token stream would keep "
+            "(generate.uses_bulk_prefill) — MoE serving needs the "
+            "one-token prefill path, not built yet"
+        )
+    if name not in SERVABLE_MODELS:
+        raise ValueError(
+            f"serving supports decode-capable LMs {SERVABLE_MODELS}, got "
+            f"model.name={name!r}"
+        )
+    attn = cfg.model.kwargs.get("attn_impl", "xla")
+    if attn != "xla":
+        raise NotImplementedError(
+            f"serving x attn_impl={attn!r}: fused/ring attention kernels "
+            "are a training feature — the paged decode cache runs the xla "
+            "core only (set model.kwargs.attn_impl='xla' or drop it)"
+        )
+    s = cfg.serving
+    if s.quant not in ("none", "int8"):
+        raise ValueError(
+            f"serving.quant must be 'none' or 'int8', got {s.quant!r}"
+        )
+    if s.slots < 1:
+        raise ValueError(f"serving.slots must be >= 1, got {s.slots}")
+    if s.block_size < 1:
+        raise ValueError(
+            f"serving.block_size must be >= 1, got {s.block_size}"
+        )
+    buckets = tuple(s.prompt_buckets)
+    if not buckets or list(buckets) != sorted(set(buckets)) or buckets[0] < 1:
+        raise ValueError(
+            "serving.prompt_buckets must be strictly increasing positive "
+            f"lengths, got {s.prompt_buckets!r}"
+        )
+
+
+class ServingEngine:
+    """Continuous batching over ``cfg.slots`` decode lanes.
+
+    ``submit()`` enqueues requests; every ``step()`` retires finished
+    lanes, admits from the queue (one bucketed prefill per admission), and
+    runs ONE decode call for the whole batch. ``run()`` drains to idle.
+
+    ``model`` must be a decode-capable LM (gpt2/llama) with
+    ``attn_impl='xla'``; the engine clones it into paged-decode mode
+    itself. ``clock`` is injectable for deterministic tests; ``emit``
+    receives ``metrics.serving_event`` records (default: collected on
+    ``self.events``).
+    """
+
+    def __init__(self, model, params, cfg, *, emit=None,
+                 clock=time.monotonic, seed: int = 0,
+                 static_batching: bool = False):
+        if getattr(model, "attn_impl", "xla") != "xla":
+            raise NotImplementedError(
+                f"serving x attn_impl={model.attn_impl!r} (see "
+                "check_serving_composition)"
+            )
+        self.cfg = cfg
+        self.clock = clock
+        # Static-batching BASELINE mode (tools/serve_bench.py): admission
+        # only into an EMPTY engine — a batch forms, runs to completion,
+        # then the next batch forms. Same compiled programs, same pool,
+        # same scheduler; the only delta is no mid-flight join, so the
+        # bench isolates exactly what continuous batching buys.
+        self.static_batching = static_batching
+        self.events: list[dict] = []
+        self._emit = emit if emit is not None else self.events.append
+        self.max_seq_len = int(cfg.max_seq_len) or int(model.max_len)
+        if self.max_seq_len > int(model.max_len):
+            raise ValueError(
+                f"serving.max_seq_len {self.max_seq_len} exceeds the "
+                f"model's max_len {model.max_len}"
+            )
+        self.buckets = tuple(sorted(int(b) for b in cfg.prompt_buckets))
+        if self.buckets[-1] >= self.max_seq_len:
+            raise ValueError(
+                f"largest prompt bucket {self.buckets[-1]} leaves no room "
+                f"for generation within max_seq_len {self.max_seq_len}"
+            )
+        S, bs = int(cfg.slots), int(cfg.block_size)
+        self.slots_n, self.block_size = S, bs
+        self.pages = blocks_for(self.max_seq_len, bs)
+
+        # --- size the pool from the HBM budget --------------------------
+        # Bytes per block from a shape-only init probe with num_blocks=1:
+        # whatever the model actually allocates per layer, no hand model.
+        probe = model.clone(decode=True, kv_pages=(1, bs, self.pages))
+        tok1 = jax.ShapeDtypeStruct((S, 1), jnp.int32)
+        shapes = jax.eval_shape(probe.init, jax.random.PRNGKey(0), tok1)
+        block_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                shapes["cache"]
+            )[0]
+            if path[-1].key in _POOL_LEAVES
+        )
+        budget = int(cfg.hbm_budget_mb) * (1 << 20)
+        self.num_blocks = budget // block_bytes
+        min_blocks = 1 + blocks_for(self.max_seq_len, bs)  # null + 1 request
+        if self.num_blocks < min_blocks:
+            raise ValueError(
+                f"serving.hbm_budget_mb={cfg.hbm_budget_mb} holds "
+                f"{self.num_blocks} KV blocks of {block_bytes} B but one "
+                f"max_seq_len={self.max_seq_len} request needs "
+                f"{min_blocks} — raise the budget or lower max_seq_len"
+            )
+        self.block_bytes = block_bytes
+        self.kv_pages = (self.num_blocks, bs, self.pages)
+        self.model = model.clone(decode=True, kv_pages=self.kv_pages)
+
+        # --- params (optionally int8 weight-quantized) ------------------
+        self.quant_report = None
+        if cfg.quant == "int8":
+            self._params, self.quant_report = quantize_params(
+                params, int(cfg.quant_block)
+            )
+            self.quant_report["max_rel_error"] = quantization_error(
+                params, int(cfg.quant_block)
+            )
+            self._dequant = dequantize_params
+        else:
+            self._params = params
+            self._dequant = lambda p: p
+
+        # --- cache: ONE concrete pytree, pool leaves authoritative ------
+        shapes_S = jax.eval_shape(
+            self.model.init, jax.random.PRNGKey(0), tok1
+        )
+        self._cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes_S["cache"]
+        )
+
+        # --- host-side scheduler + per-lane operand rows ----------------
+        self.scheduler = Scheduler(
+            S, KVBlockPool(self.num_blocks, bs), self.max_seq_len
+        )
+        self._table = np.zeros((S, self.pages), np.int32)
+        self._lens = np.zeros((S,), np.int32)
+        self._tok = np.zeros((S,), np.int32)
+        self._temp = np.zeros((S,), np.float32)
+        self._top_k = np.zeros((S,), np.int32)
+        self._top_p = np.zeros((S,), np.float32)
+        self._rng = np.zeros((S, 2), np.uint32)
+        self._seed = int(seed)
+
+        # --- compiled executables ---------------------------------------
+        self._prefill_exe: dict[int, object] = {}  # bucket P -> executable
+        self._decode_exe = None
+        self.num_compiles = 0
+        self.calls = {"prefill": 0, "decode": 0}
+        self.step_count = 0
+
+    # ------------------------------------------------------------------
+    # cache plumbing: host arrays in, pool arrays shared across programs
+    # ------------------------------------------------------------------
+
+    def _inject(self, cache, table, lens):
+        """Swap every ``page_table``/``seq_lens`` leaf (by NAME, at any
+        depth — per-layer attention cursors and gpt2's position cursor
+        alike) for host-built arrays of the target batch size."""
+        table = jnp.asarray(table, jnp.int32)
+        lens = jnp.asarray(lens, jnp.int32)
+
+        def pick(path, leaf):
+            name = getattr(path[-1], "key", None)
+            if name == "page_table":
+                return table
+            if name == "seq_lens":
+                return lens
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(pick, cache)
+
+    def _fold_pools(self, updated):
+        """Adopt the pool leaves a B=1 prefill just wrote; every other
+        leaf keeps its decode-batch shape."""
+        self._cache = jax.tree_util.tree_map_with_path(
+            lambda p, old, new: (
+                new if getattr(p[-1], "key", None) in _POOL_LEAVES else old
+            ),
+            self._cache, updated,
+        )
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+
+    def _sample_body(self, logits, rng, temp, top_k, top_p):
+        greedy = jnp.argmax(logits, axis=-1)
+        tempered = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
+        filtered = _filter_logits(tempered, top_k, top_p)
+        split = jax.vmap(jax.random.split)(rng)  # [B, 2, 2]
+        sampled = jax.vmap(jax.random.categorical)(split[:, 0], filtered)
+        tok = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+        return tok, split[:, 1]
+
+    def _prefill_fn(self, params, cache, tokens, pos, rng, temp, tk, tp):
+        out, cache = prefill(self.model, self._dequant(params), cache, tokens)
+        tok, rng = self._sample_body(logits_at(out, pos), rng, temp, tk, tp)
+        return tok, rng, cache
+
+    def _decode_fn(self, params, cache, tok, rng, temp, tk, tp):
+        logits, cache = decode_step(
+            self.model, self._dequant(params), cache, tok
+        )
+        tok, rng = self._sample_body(logits, rng, temp, tk, tp)
+        return tok, rng, cache
+
+    def _compile(self, fn, *args):
+        self.num_compiles += 1
+        return jax.jit(fn).lower(*args).compile()
+
+    def _prefill_exe_for(self, bucket: int):
+        exe = self._prefill_exe.get(bucket)
+        if exe is None:
+            cache1 = self._inject(
+                self._cache,
+                np.zeros((1, self.pages), np.int32),
+                np.zeros((1,), np.int32),
+            )
+            exe = self._compile(
+                self._prefill_fn, self._params, cache1,
+                np.zeros((1, bucket), np.int32), np.zeros((1,), np.int32),
+                np.zeros((1, 2), np.uint32), np.zeros((1,), np.float32),
+                np.zeros((1,), np.int32), np.zeros((1,), np.float32),
+            )
+            self._prefill_exe[bucket] = exe
+        return exe
+
+    def _decode_exe_or_compile(self):
+        if self._decode_exe is None:
+            S = self.slots_n
+            cacheS = self._inject(self._cache, self._table, self._lens)
+            self._decode_exe = self._compile(
+                self._decode_fn, self._params, cacheS,
+                np.zeros((S, 1), np.int32), np.zeros((S, 2), np.uint32),
+                np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                np.zeros((S,), np.float32),
+            )
+        return self._decode_exe
+
+    def warmup(self):
+        """Compile the decode graph and every bucket's prefill graph now,
+        so the serving loop's first requests don't pay compile latency
+        (serve_bench calls this before the timed window)."""
+        self._decode_exe_or_compile()
+        for b in self.buckets:
+            self._prefill_exe_for(b)
+
+    # ------------------------------------------------------------------
+    # request lifecycle
+    # ------------------------------------------------------------------
+
+    def bucket_of(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest "
+            f"serving.prompt_buckets entry {self.buckets[-1]}"
+        )
+
+    def submit(self, request: Request) -> RequestState:
+        self.bucket_of(len(request.prompt))  # fail before enqueueing
+        return self.scheduler.submit(request, self.clock())
+
+    def _event(self, name: str, state: RequestState, **fields):
+        self._emit(serving_event(
+            name, self.step_count,
+            request_id=state.request.request_id, **fields,
+        ))
+
+    def _finish_if_done(self, state: RequestState, tok: int) -> bool:
+        req = state.request
+        done = len(state.generated) >= req.max_new_tokens or (
+            self.cfg.eos_id >= 0 and tok == self.cfg.eos_id
+        )
+        if done:
+            slot = state.slot
+            self.scheduler.complete(slot, self.clock())
+            self._temp[slot] = 0.0
+            self._lens[slot] = 0
+            self._table[slot] = 0  # park the lane on the null block
+            self._event(
+                "request_completed", state,
+                new_tokens=len(state.generated),
+                slot=slot,
+            )
+        return done
+
+    def _admit_one(self, state: RequestState):
+        req, slot = state.request, state.slot
+        P = state.bucket
+        row = np.zeros((self.pages,), np.int32)
+        row[: len(state.blocks)] = state.blocks
+        tokens = np.zeros((1, P), np.int32)
+        tokens[0, : len(req.prompt)] = req.prompt  # RIGHT-padded to bucket
+        rng = np.asarray(
+            jax.random.fold_in(
+                jax.random.PRNGKey(self._seed), req.request_id
+            ),
+            np.uint32,
+        )[None]
+        temp = np.float32([req.temperature])
+        tk = np.int32([req.top_k])
+        tp = np.float32([req.top_p])
+        pos = np.int32([len(req.prompt) - 1])
+        exe = self._prefill_exe_for(P)
+        cache1 = self._inject(self._cache, row[None], np.zeros((1,), np.int32))
+        tok, rng_out, cache1 = exe(
+            self._params, cache1, tokens, pos, rng, temp, tk, tp
+        )
+        self.calls["prefill"] += 1
+        self._fold_pools(cache1)
+        tok = int(tok[0])
+        now = self.clock()
+        state.generated.append(tok)
+        state.first_token_s = now
+        state.token_times_s.append(now)
+        # Arm the lane for decode: the KV holds len real positions (pad
+        # writes beyond len are masked and will be overwritten in place).
+        self._table[slot] = row
+        self._lens[slot] = len(req.prompt)
+        self._tok[slot] = tok
+        self._temp[slot] = req.temperature
+        self._top_k[slot] = req.top_k
+        self._top_p[slot] = req.top_p
+        self._rng[slot] = np.asarray(rng_out[0], np.uint32)
+        self._event(
+            "first_token", state, slot=slot,
+            ttft_s=round(now - state.arrival_s, 6),
+        )
+        self._finish_if_done(state, tok)
+
+    def step(self) -> bool:
+        """One engine iteration: admit (+prefill) into free lanes, then one
+        decode call for the whole batch. Returns False when idle."""
+        self.step_count += 1
+        now = self.clock()
+        admitted = (
+            [] if self.static_batching and self.scheduler.active
+            else self.scheduler.admit(now, self.bucket_of)
+        )
+        for state in admitted:
+            self._event(
+                "request_admitted", state, slot=state.slot,
+                bucket=state.bucket, blocks=len(state.blocks),
+                queue_s=round(now - state.arrival_s, 6),
+            )
+            self._admit_one(state)
+        active = self.scheduler.active
+        if not active:
+            return not self.scheduler.idle
+        cacheS = self._inject(self._cache, self._table, self._lens)
+        tok, rng, cacheS = self._decode_exe_or_compile()(
+            self._params, cacheS, self._tok[:, None], self._rng,
+            self._temp, self._top_k, self._top_p,
+        )
+        self.calls["decode"] += 1
+        self._cache = cacheS
+        tok = np.asarray(tok)
+        # np.array (copy): rows must stay writable for the next admission.
+        self._rng = np.array(rng, np.uint32)
+        now = self.clock()
+        for state in active:
+            slot = state.slot
+            t = int(tok[slot])
+            state.generated.append(t)
+            state.token_times_s.append(now)
+            self._lens[slot] += 1
+            self._tok[slot] = t
+            self._finish_if_done(state, t)
+        return not self.scheduler.idle
+
+    def run(self, max_steps: int = 0) -> list[RequestState]:
+        """Drain the queue; returns the finished states (submit order)."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps and steps >= max_steps:
+                break
+        return sorted(
+            self.scheduler.finished,
+            key=lambda s: s.request.request_id,
+        )
+
+    def stats(self) -> dict:
+        return {
+            **self.scheduler.stats(),
+            "num_blocks": self.num_blocks,
+            "block_bytes": self.block_bytes,
+            "pages_per_seq": self.pages,
+            "prompt_buckets": list(self.buckets),
+            "num_compiles": self.num_compiles,
+            "calls": dict(self.calls),
+            "steps": self.step_count,
+            "quant": self.quant_report,
+        }

@@ -1,0 +1,280 @@
+"""Host-side serving state: requests, the KV block pool, and the
+continuous-batching scheduler.
+
+Everything in this module is pure Python — no jax imports — so admission
+policy, block accounting, and lifecycle bookkeeping are unit-testable
+without a device (tests/test_serving_units.py). The device half (compiled
+prefill/decode graphs, the paged pool arrays those blocks index into) lives
+in ``serving/engine.py``.
+
+Design (docs/SERVING.md):
+
+- **KVBlockPool** — a free-list allocator over ``num_blocks`` fixed-size
+  blocks of the device-side KV pool. Block 0 is reserved as the NULL block
+  (idle decode slots point their whole page table at it), so user blocks
+  are ``1..num_blocks-1``. Allocation is all-or-nothing per request.
+- **Scheduler** — FIFO admission into ``slots`` decode lanes. A queued
+  request is admitted when a lane is free AND the pool can hold its whole
+  worst-case sequence (prompt bucket + ``max_new_tokens``, rounded up to
+  blocks). Reserving up front means a running request can never hit a
+  mid-flight allocation failure — no preemption machinery in v1, at the
+  cost of conservative occupancy (the tradeoff is documented and the
+  high-water stats expose it).
+- Requests join and leave **mid-flight**: every engine step first retires
+  finished lanes (freeing their blocks), then admits from the queue into
+  whatever lanes are open — the decode batch never drains to refill.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` KV entries (ceil division)."""
+    return -(-tokens // block_size)
+
+
+class KVBlockPool:
+    """Free-list allocator over the paged KV pool's physical blocks.
+
+    ``alloc(n)`` returns a list of n block ids or ``None`` (never partial);
+    ``free(ids)`` returns them. Double-free and freeing the null block are
+    hard errors — a leak here silently corrupts another request's KV.
+    """
+
+    NULL_BLOCK = 0
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"KV pool needs >= 2 blocks (1 null + 1 usable), got "
+                f"{num_blocks} — raise serving.hbm_budget_mb or shrink "
+                "serving.block_size"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO free list: recently-freed (cache-warm) blocks are reused
+        # first, and page-table reuse after completion is deterministic.
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._allocated: set[int] = set()
+        self.high_water = 0
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._allocated)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        if n < 1:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self._allocated.update(got)
+        self.high_water = max(self.high_water, len(self._allocated))
+        return got
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b == self.NULL_BLOCK:
+                raise ValueError("freeing the null block")
+            if b not in self._allocated:
+                raise ValueError(f"double/foreign free of block {b}")
+            self._allocated.remove(b)
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request as submitted. ``temperature == 0`` is greedy;
+    ``deadline_s`` (absolute engine-clock time) drops the request if it is
+    still QUEUED past the deadline — an admitted request always runs to
+    completion."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    request_id: int | None = None
+    deadline_s: float | None = None
+
+
+@dataclasses.dataclass
+class RequestState:
+    """Scheduler-side lifecycle record for one request."""
+
+    request: Request
+    arrival_s: float
+    bucket: int = 0  # prompt bucket P chosen at admission
+    blocks: list[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    generated: list[int] = dataclasses.field(default_factory=list)
+    admit_s: float | None = None
+    first_token_s: float | None = None
+    finish_s: float | None = None
+    token_times_s: list[float] = dataclasses.field(default_factory=list)
+    dropped: bool = False
+
+    @property
+    def done(self) -> bool:
+        return self.finish_s is not None
+
+    def metrics(self) -> dict:
+        """Per-request latency record (serve_bench aggregates these)."""
+        itl = [
+            b - a for a, b in zip(self.token_times_s, self.token_times_s[1:])
+        ]
+        return {
+            "request_id": self.request.request_id,
+            "prompt_len": len(self.request.prompt),
+            "new_tokens": len(self.generated),
+            "queue_s": (
+                None if self.admit_s is None
+                else round(self.admit_s - self.arrival_s, 6)
+            ),
+            "ttft_s": (
+                None if self.first_token_s is None
+                else round(self.first_token_s - self.arrival_s, 6)
+            ),
+            "e2e_s": (
+                None if self.finish_s is None
+                else round(self.finish_s - self.arrival_s, 6)
+            ),
+            "inter_token_s": [round(x, 6) for x in itl],
+            "dropped": self.dropped,
+        }
+
+
+class Scheduler:
+    """Continuous-batching admission over ``slots`` decode lanes.
+
+    The engine drives it: ``submit()`` enqueues; ``admit(now)`` pops FIFO
+    while a lane AND blocks are available, returning the newly-placed
+    states (the engine then runs one prefill per placement); ``complete()``
+    retires a lane and frees its blocks. No jax anywhere.
+    """
+
+    def __init__(self, slots: int, pool: KVBlockPool, max_seq_len: int):
+        if slots < 1:
+            raise ValueError(f"serving.slots must be >= 1, got {slots}")
+        self.slots: list[RequestState | None] = [None] * slots
+        self.pool = pool
+        self.max_seq_len = max_seq_len
+        self.pending: deque[RequestState] = deque()
+        self.finished: list[RequestState] = []
+        self.dropped: list[RequestState] = []
+        self._ids = itertools.count()
+        self.admitted_total = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, request: Request, now: float) -> RequestState:
+        if not request.prompt:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(request.prompt) + request.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(request.prompt)}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds serving.max_seq_len "
+                f"{self.max_seq_len}"
+            )
+        if request.request_id is None:
+            request.request_id = next(self._ids)
+        state = RequestState(request=request, arrival_s=now)
+        self.pending.append(state)
+        return state
+
+    # -- admission ---------------------------------------------------------
+
+    def free_slot(self) -> int:
+        try:
+            return self.slots.index(None)
+        except ValueError:
+            return -1
+
+    def admit(self, now: float, bucket_of) -> list[RequestState]:
+        """FIFO-admit queued requests while a lane + blocks are available.
+        ``bucket_of(prompt_len) -> P`` supplies the engine's prompt bucket
+        (block reservation must cover the BUCKET: bulk prefill writes pad
+        KV into the row's own pages — transformer.paged_decode_attention).
+        Head-of-line blocking is deliberate: skipping ahead would starve
+        large requests under load."""
+        placed = []
+        while self.pending:
+            state = self.pending[0]
+            req = state.request
+            if req.deadline_s is not None and now > req.deadline_s:
+                self.pending.popleft()
+                state.dropped = True
+                state.finish_s = now
+                self.dropped.append(state)
+                continue
+            slot = self.free_slot()
+            if slot < 0:
+                break
+            bucket = bucket_of(len(req.prompt))
+            need = blocks_for(
+                max(bucket, len(req.prompt) + req.max_new_tokens),
+                self.pool.block_size,
+            )
+            blocks = self.pool.alloc(need)
+            if blocks is None:
+                break
+            self.pending.popleft()
+            state.bucket = bucket
+            state.blocks = blocks
+            state.slot = slot
+            state.admit_s = now
+            self.slots[slot] = state
+            self.admitted_total += 1
+            placed.append(state)
+        return placed
+
+    # -- retirement --------------------------------------------------------
+
+    def complete(self, slot: int, now: float) -> RequestState:
+        state = self.slots[slot]
+        if state is None:
+            raise ValueError(f"slot {slot} is empty")
+        state.finish_s = now
+        self.pool.free(state.blocks)
+        state.blocks = []
+        self.slots[slot] = None
+        self.finished.append(state)
+        return state
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def active(self) -> list[RequestState]:
+        return [s for s in self.slots if s is not None]
+
+    @property
+    def idle(self) -> bool:
+        return not self.pending and not self.active
+
+    def stats(self) -> dict:
+        return {
+            "pending": len(self.pending),
+            "active": len(self.active),
+            "finished": len(self.finished),
+            "dropped": len(self.dropped),
+            "admitted_total": self.admitted_total,
+            "free_blocks": self.pool.free_blocks,
+            "used_blocks": self.pool.used_blocks,
+            "block_high_water": self.pool.high_water,
+        }

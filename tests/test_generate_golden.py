@@ -1,0 +1,49 @@
+"""Golden pin for generate(): bit-identity across the prefill/decode_step
+refactor (the serving engine shares those bodies — this file is what makes
+"refactor, don't fork" enforceable).
+
+``tests/generate_golden.json`` was captured from the PRE-refactor
+generate() (greedy + sampled, gpt2 + llama). Any change to the shared
+decode bodies that shifts a single token fails here. Regenerate ONLY for
+an intentional numerics change, with the recipe below (it is the literal
+test body — same seeds, same shapes).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.generate import generate, pad_prompts
+
+_GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "generate_golden.json")
+
+
+def _run(name: str):
+    model = models.get_model(name, size="tiny", vocab_size=97, max_len=64)
+    rng = np.random.default_rng(42)
+    prompts = [list(map(int, rng.integers(1, 97, n))) for n in (5, 9, 3)]
+    padded, lens = pad_prompts(prompts, pad_id=0)
+    params = model.init(jax.random.PRNGKey(7), padded)["params"]
+    greedy = generate(
+        model, params, padded, max_new_tokens=11, prompt_lens=lens
+    )
+    sampled = generate(
+        model, params, padded, max_new_tokens=11, prompt_lens=lens,
+        temperature=0.8, top_k=7, top_p=0.9, rng=jax.random.PRNGKey(13),
+    )
+    return np.asarray(greedy), np.asarray(sampled)
+
+
+@pytest.mark.parametrize("name", ["gpt2", "llama"])
+def test_generate_matches_pre_refactor_golden(name):
+    with open(_GOLDEN) as f:
+        golden = json.load(f)[name]
+    greedy, sampled = _run(name)
+    np.testing.assert_array_equal(greedy, np.asarray(golden["greedy"]))
+    np.testing.assert_array_equal(sampled, np.asarray(golden["sampled"]))

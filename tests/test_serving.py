@@ -1,0 +1,328 @@
+"""Serving engine (serving/engine.py): paged-KV correctness against
+generate(), zero-recompile steady state, per-request isolation, int8
+weight quantization, lifecycle events, config wiring, and the
+composition fences."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.config import (
+    Config,
+    ModelConfig,
+    ServingConfig,
+    apply_overrides,
+)
+from distributeddeeplearning_tpu.generate import generate, pad_prompts
+from distributeddeeplearning_tpu.serving import (
+    Request,
+    ServingEngine,
+    check_serving_composition,
+)
+
+_CFG = ServingConfig(
+    slots=3, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+    prompt_buckets=(8, 16),
+)
+
+
+def _fake_clock():
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.001
+        return t[0]
+
+    return clock
+
+
+def _model_and_params(name, seed=7):
+    model = models.get_model(name, size="tiny", vocab_size=97, max_len=64)
+    params = model.init(
+        jax.random.PRNGKey(seed), np.zeros((1, 8), np.int32)
+    )["params"]
+    return model, params
+
+
+def _prompts(lens, seed=42):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(1, 97, n))) for n in lens]
+
+
+def _engine(model, params, cfg=_CFG, **kw):
+    return ServingEngine(model, params, cfg, clock=_fake_clock(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# Correctness: continuous batching == generate(), token for token
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["gpt2", "llama"])
+def test_engine_greedy_matches_generate(name):
+    # 5 requests over 3 lanes: lanes retire and refill mid-flight, prompts
+    # span both buckets — and every request's greedy tokens must equal a
+    # plain generate() of that prompt (paged cache + continuous batching
+    # change the SCHEDULE, never the numbers). Llama covers the GQA path.
+    model, params = _model_and_params(name)
+    prompts = _prompts((5, 9, 3, 12, 7))
+    padded, lens = pad_prompts(prompts, pad_id=0)
+    ref = np.asarray(generate(
+        model, params, padded, max_new_tokens=11, prompt_lens=lens
+    ))[:, -11:]
+    eng = _engine(model, params)
+    for p in prompts:
+        eng.submit(Request(prompt=p, max_new_tokens=11))
+    done = eng.run()
+    assert len(done) == len(prompts)
+    assert eng.scheduler.stats()["used_blocks"] == 0  # all pages released
+    for i, st in enumerate(done):
+        assert st.generated == list(ref[i]), f"request {i}"
+
+
+def test_mid_flight_join_uses_freed_slot_and_blocks():
+    model, params = _model_and_params("gpt2")
+    cfg = dataclasses.replace(_CFG, slots=2)
+    eng = _engine(model, params, cfg)
+    short = eng.submit(Request(prompt=_prompts((4,))[0], max_new_tokens=2))
+    long = eng.submit(Request(prompt=_prompts((5,))[0], max_new_tokens=12))
+    late = eng.submit(Request(prompt=_prompts((6,))[0], max_new_tokens=3))
+    eng.run()
+    # late could only run after short left; long never left its lane
+    assert short.slot == late.slot
+    assert long.finish_s > short.finish_s
+    assert late.admit_s > short.finish_s - 1  # joined while long in flight
+    assert late.admit_s < long.finish_s
+
+
+# ---------------------------------------------------------------------------
+# Zero recompiles in steady state (AOT executables, pinned counts)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_count_is_pinned_across_traffic():
+    model, params = _model_and_params("gpt2")
+    eng = _engine(model, params)
+    eng.warmup()
+    expected = len(_CFG.prompt_buckets) + 1  # per-bucket prefill + decode
+    assert eng.num_compiles == expected
+    # Traffic of every shape the engine admits: all buckets, varied
+    # max_new, join/leave churn — compile count must not move.
+    for plen, new in [(3, 2), (8, 5), (9, 7), (16, 1), (1, 9), (12, 4)]:
+        eng.submit(Request(prompt=_prompts((plen,))[0], max_new_tokens=new))
+    eng.run()
+    assert eng.num_compiles == expected
+    assert eng.calls["prefill"] == 6
+    assert eng.calls["decode"] > 0
+
+
+def test_lazy_compile_only_touched_buckets():
+    model, params = _model_and_params("gpt2")
+    eng = _engine(model, params)
+    eng.submit(Request(prompt=_prompts((4,))[0], max_new_tokens=2))
+    eng.run()
+    # bucket 8 + decode; bucket 16 never compiled
+    assert eng.num_compiles == 2
+    assert list(eng._prefill_exe) == [8]
+
+
+# ---------------------------------------------------------------------------
+# Per-request sampling isolation
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_request_is_independent_of_batchmates():
+    # A request's rng chain is fold_in(seed, request_id) and its logits see
+    # only its own pages — so request 0's tokens must be identical no
+    # matter what shares the batch with it.
+    model, params = _model_and_params("gpt2")
+    a = _prompts((6,))[0]
+    outs = []
+    for other_lens in ((3, 9), (11, 2)):
+        eng = _engine(model, params, seed=5)
+        first = eng.submit(Request(
+            prompt=a, max_new_tokens=8, temperature=0.9, top_k=11,
+        ))
+        for p in _prompts(other_lens, seed=hash(other_lens) % 1000):
+            eng.submit(Request(
+                prompt=p, max_new_tokens=6, temperature=0.7, top_p=0.8,
+            ))
+        eng.run()
+        outs.append(list(first.generated))
+        assert all(0 <= t < 97 for t in first.generated)
+    assert outs[0] == outs[1]
+
+
+def test_greedy_and_sampled_mix_in_one_batch():
+    model, params = _model_and_params("gpt2")
+    prompts = _prompts((5, 5, 5))
+    ref = np.asarray(generate(
+        model, params, np.asarray([prompts[0]], np.int32), max_new_tokens=6
+    ))[0, -6:]
+    eng = _engine(model, params, seed=1)
+    greedy = eng.submit(Request(prompt=prompts[0], max_new_tokens=6))
+    eng.submit(Request(prompt=prompts[1], max_new_tokens=6,
+                       temperature=1.2, top_k=13))
+    eng.submit(Request(prompt=prompts[2], max_new_tokens=6,
+                       temperature=0.6, top_p=0.7))
+    eng.run()
+    # the greedy lane is untouched by its sampled batchmates
+    assert greedy.generated == list(ref)
+
+
+# ---------------------------------------------------------------------------
+# int8 weight-quantized serving
+# ---------------------------------------------------------------------------
+
+
+def test_int8_quant_mode_serves_and_reports():
+    model, params = _model_and_params("llama")
+    cfg = dataclasses.replace(_CFG, quant="int8", quant_block=64)
+    eng = _engine(model, params, cfg)
+    rep = eng.quant_report
+    assert rep["param_bytes_quant"] < 0.35 * rep["param_bytes_fp"]
+    assert rep["max_rel_error"] < 0.05
+    states = [
+        eng.submit(Request(prompt=p, max_new_tokens=6))
+        for p in _prompts((4, 7))
+    ]
+    eng.run()
+    for st in states:
+        assert len(st.generated) == 6
+        assert all(0 <= t < 97 for t in st.generated)
+
+
+def test_quantized_leaf_roundtrip_error_is_small():
+    from distributeddeeplearning_tpu.serving.quant import (
+        dequantize_params,
+        quantize_params,
+    )
+
+    rng = np.random.default_rng(0)
+    params = {"w": rng.normal(size=(32, 48)).astype(np.float32),
+              "b": rng.normal(size=(48,)).astype(np.float32)}
+    tree, report = quantize_params(params, block_size=64)
+    back = dequantize_params(tree)
+    assert back["b"] is params["b"]  # 1-D leaves pass through untouched
+    assert back["w"].shape == (32, 48)
+    err = np.abs(np.asarray(back["w"]) - params["w"]).max()
+    assert err < np.abs(params["w"]).max() / 100
+    assert report["ratio"] < 0.5
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle events (metrics.serving_event)
+# ---------------------------------------------------------------------------
+
+
+def test_event_stream_per_request_lifecycle():
+    model, params = _model_and_params("gpt2")
+    eng = _engine(model, params)
+    states = [
+        eng.submit(Request(prompt=p, max_new_tokens=3))
+        for p in _prompts((4, 6, 5, 3))
+    ]
+    eng.run()
+    for st in states:
+        rid = st.request.request_id
+        mine = [e for e in eng.events if e["request_id"] == rid]
+        names = [e["event"] for e in mine]
+        assert names == ["request_admitted", "first_token",
+                         "request_completed"]
+        admitted, first, completed = mine
+        assert admitted["bucket"] == st.bucket
+        assert first["ttft_s"] >= 0
+        assert completed["new_tokens"] == 3
+    # events ride the engine step counter monotonically
+    steps = [e["step"] for e in eng.events]
+    assert steps == sorted(steps)
+
+
+def test_serving_event_rejects_unknown_name():
+    from distributeddeeplearning_tpu.metrics import serving_event
+
+    with pytest.raises(ValueError, match="unknown serving event"):
+        serving_event("request_vanished", 0, request_id=1)
+
+
+# ---------------------------------------------------------------------------
+# Config wiring + composition fences
+# ---------------------------------------------------------------------------
+
+
+def _cfg(name="gpt2", model_kwargs=None, serving=None):
+    return Config(
+        model=ModelConfig(name=name, kwargs=model_kwargs or {}),
+        serving=serving or ServingConfig(),
+    )
+
+
+def test_serving_config_overrides_wire_through():
+    cfg = apply_overrides(_cfg(), [
+        "serving.slots=8", "serving.quant=int8",
+        "serving.prompt_buckets=(16,64)",
+    ])
+    assert cfg.serving.slots == 8
+    assert cfg.serving.quant == "int8"
+    assert cfg.serving.prompt_buckets == (16, 64)
+
+
+def test_serving_block_rejects_scalar_override():
+    with pytest.raises(ValueError, match=r"serving is a config block"):
+        apply_overrides(_cfg(), ["serving=fast"])
+
+
+def test_fence_pipelined_model():
+    with pytest.raises(NotImplementedError, match="pipelined"):
+        check_serving_composition(_cfg(name="gpt2_pp"))
+
+
+def test_fence_capacity_moe():
+    with pytest.raises(NotImplementedError, match="capacity-MoE"):
+        check_serving_composition(_cfg(name="llama_moe"))
+
+
+def test_fence_non_decode_model():
+    with pytest.raises(ValueError, match="decode-capable"):
+        check_serving_composition(_cfg(name="resnet18"))
+
+
+def test_fence_fused_attention():
+    with pytest.raises(NotImplementedError, match="attn_impl='xla'"):
+        check_serving_composition(
+            _cfg(model_kwargs={"attn_impl": "ulysses_flash"})
+        )
+
+
+def test_fence_bad_quant_and_buckets():
+    with pytest.raises(ValueError, match="serving.quant"):
+        check_serving_composition(
+            _cfg(serving=ServingConfig(quant="fp4"))
+        )
+    with pytest.raises(ValueError, match="prompt_buckets"):
+        check_serving_composition(
+            _cfg(serving=ServingConfig(prompt_buckets=(64, 32)))
+        )
+
+
+def test_fence_xla_attn_passes():
+    check_serving_composition(_cfg(name="llama"))
+    check_serving_composition(_cfg(model_kwargs={"attn_impl": "xla"}))
+
+
+def test_engine_rejects_undersized_hbm_budget():
+    model, params = _model_and_params("gpt2")
+    cfg = dataclasses.replace(_CFG, hbm_budget_mb=0)
+    with pytest.raises(ValueError, match="hbm_budget_mb"):
+        ServingEngine(model, params, cfg)
+
+
+def test_engine_rejects_prompt_beyond_largest_bucket():
+    model, params = _model_and_params("gpt2")
+    eng = _engine(model, params)
+    with pytest.raises(ValueError, match="largest"):
+        eng.submit(Request(prompt=list(range(1, 20)), max_new_tokens=2))

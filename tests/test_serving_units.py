@@ -1,0 +1,222 @@
+"""Host-side serving units: KV block pool + continuous-batching scheduler.
+
+Pure Python (serving/scheduler.py imports no jax) — admission policy and
+block accounting are exercised here without a device; the device half is
+tests/test_serving.py.
+"""
+
+import pytest
+
+from distributeddeeplearning_tpu.serving.scheduler import (
+    KVBlockPool,
+    Request,
+    Scheduler,
+    blocks_for,
+)
+
+
+def _bucket_of(plen):
+    for b in (8, 16, 32):
+        if plen <= b:
+            return b
+    raise ValueError(plen)
+
+
+def _sched(slots=2, num_blocks=64, block_size=4, max_seq_len=32):
+    return Scheduler(slots, KVBlockPool(num_blocks, block_size), max_seq_len)
+
+
+def _req(plen=4, max_new=4, **kw):
+    return Request(prompt=list(range(1, plen + 1)), max_new_tokens=max_new,
+                   **kw)
+
+
+# ---------------------------------------------------------------------------
+# KVBlockPool
+# ---------------------------------------------------------------------------
+
+
+def test_blocks_for_is_ceil_division():
+    assert blocks_for(1, 4) == 1
+    assert blocks_for(4, 4) == 1
+    assert blocks_for(5, 4) == 2
+    assert blocks_for(32, 16) == 2
+
+
+def test_pool_reserves_null_block():
+    pool = KVBlockPool(8, 4)
+    got = pool.alloc(7)  # everything except block 0
+    assert got is not None and 0 not in got
+    assert pool.alloc(1) is None
+
+
+def test_pool_alloc_is_all_or_nothing():
+    pool = KVBlockPool(4, 4)  # 3 usable
+    assert pool.alloc(4) is None
+    assert pool.free_blocks == 3  # nothing partially consumed
+    got = pool.alloc(3)
+    assert sorted(got) == [1, 2, 3]
+
+
+def test_pool_double_free_and_null_free_are_errors():
+    pool = KVBlockPool(4, 4)
+    got = pool.alloc(2)
+    pool.free(got)
+    with pytest.raises(ValueError, match="double/foreign"):
+        pool.free([got[0]])
+    with pytest.raises(ValueError, match="null block"):
+        pool.free([0])
+
+
+def test_pool_lifo_reuse_is_deterministic():
+    # Freed blocks come back most-recently-freed first — page-table reuse
+    # after completion is reproducible run to run.
+    pool = KVBlockPool(8, 4)
+    a = pool.alloc(3)
+    pool.free(a)
+    b = pool.alloc(3)
+    assert b == a[::-1]
+
+
+def test_pool_rejects_degenerate_shapes():
+    with pytest.raises(ValueError, match=">= 2 blocks"):
+        KVBlockPool(1, 4)
+    with pytest.raises(ValueError, match="block_size"):
+        KVBlockPool(8, 0)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: admission
+# ---------------------------------------------------------------------------
+
+
+def test_admission_is_fifo():
+    s = _sched(slots=2)
+    ids = [s.submit(_req(), now=0.0).request.request_id for _ in range(4)]
+    placed = s.admit(1.0, _bucket_of)
+    assert [p.request.request_id for p in placed] == ids[:2]
+    assert [p.slot for p in placed] == [0, 1]
+    assert len(s.pending) == 2
+
+
+def test_admission_reserves_bucket_not_prompt_len():
+    # Bulk prefill writes pad KV into the row's own pages, so the
+    # reservation must cover max(bucket, prompt + max_new).
+    s = _sched(slots=1, block_size=4)
+    s.submit(_req(plen=3, max_new=2), now=0.0)  # bucket 8 > 3+2=5
+    (placed,) = s.admit(0.0, _bucket_of)
+    assert len(placed.blocks) == blocks_for(8, 4) == 2
+
+
+def test_admission_blocks_on_pool_exhaustion_not_slots():
+    # 2 free lanes but pool for only one request: head-of-line waits.
+    s = _sched(slots=2, num_blocks=3, block_size=4)  # 2 usable blocks
+    s.submit(_req(plen=4, max_new=4), now=0.0)  # needs 2 blocks
+    s.submit(_req(plen=4, max_new=4), now=0.0)
+    placed = s.admit(0.0, _bucket_of)
+    assert len(placed) == 1 and len(s.pending) == 1
+    s.complete(placed[0].slot, now=1.0)
+    placed2 = s.admit(1.0, _bucket_of)
+    assert len(placed2) == 1
+
+
+def test_mid_flight_join_and_leave():
+    # One lane retires, a queued request takes it immediately — the other
+    # lane keeps running (continuous batching, host half).
+    s = _sched(slots=2)
+    first, second, third = (s.submit(_req(), now=float(i)) for i in range(3))
+    s.admit(3.0, _bucket_of)
+    assert third.slot == -1
+    done = s.complete(first.slot, now=4.0)
+    assert done is first and first.done
+    (joined,) = s.admit(4.0, _bucket_of)
+    assert joined is third and third.slot == done.slot
+    assert second.slot != -1 and not second.done  # undisturbed
+
+
+def test_deadline_drops_only_queued_requests():
+    s = _sched(slots=1)
+    a = s.submit(_req(deadline_s=10.0), now=0.0)
+    b = s.submit(_req(deadline_s=0.5), now=0.0)
+    s.admit(1.0, _bucket_of)  # a admitted; b expired in queue
+    assert a.slot == 0
+    assert b.dropped and b in s.dropped
+    # an ADMITTED request past its deadline still runs to completion
+    a.request.deadline_s = 0.1
+    s.complete(0, now=5.0)
+    assert not a.dropped
+
+
+def test_submit_validates():
+    s = _sched(max_seq_len=16)
+    with pytest.raises(ValueError, match="empty prompt"):
+        s.submit(Request(prompt=[], max_new_tokens=1), now=0.0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        s.submit(Request(prompt=[1], max_new_tokens=0), now=0.0)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        s.submit(_req(plen=10, max_new=10), now=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Leak check: 1k simulated requests
+# ---------------------------------------------------------------------------
+
+
+def test_no_block_leaks_across_1k_requests():
+    import random
+
+    rnd = random.Random(0)
+    s = _sched(slots=4, num_blocks=32, block_size=4, max_seq_len=32)
+    submitted = finished = 0
+    now = 0.0
+    while finished < 1000:
+        now += 1.0
+        if submitted < 1000 and len(s.pending) < 8:
+            s.submit(_req(plen=rnd.randint(1, 8),
+                          max_new=rnd.randint(1, 8)), now=now)
+            submitted += 1
+        s.admit(now, _bucket_of)
+        for st in list(s.active):
+            if rnd.random() < 0.5:  # leave mid-flight at random times
+                s.complete(st.slot, now=now)
+                finished += 1
+        # invariant at every step: used + free == usable, no orphans
+        assert s.pool.used_blocks + s.pool.free_blocks == 31
+        assert s.pool.used_blocks == sum(
+            len(st.blocks) for st in s.active
+        )
+    assert s.pool.used_blocks == 0
+    assert s.pool.free_blocks == 31
+    assert s.pool.high_water <= 31
+    assert len(s.finished) == 1000
+    for st in s.finished:
+        assert st.blocks == []  # released on completion
+
+
+def test_page_table_reuse_after_completion():
+    # Blocks released by a finished request are handed to the next one
+    # (LIFO) — the pool does not strand address space across lifetimes.
+    s = _sched(slots=1, num_blocks=4, block_size=4, max_seq_len=12)
+    s.submit(_req(plen=4, max_new=4), now=0.0)
+    (a,) = s.admit(0.0, _bucket_of)
+    blocks_a = list(a.blocks)
+    s.complete(0, now=1.0)
+    s.submit(_req(plen=4, max_new=4), now=1.0)
+    (b,) = s.admit(1.0, _bucket_of)
+    assert sorted(b.blocks) == sorted(blocks_a)
+
+
+def test_metrics_record_shape():
+    s = _sched(slots=1)
+    st = s.submit(_req(plen=4, max_new=2), now=1.0)
+    s.admit(2.0, _bucket_of)
+    st.first_token_s = 2.5
+    st.token_times_s = [2.5, 2.7]
+    st.generated = [9, 9]
+    s.complete(0, now=2.7)
+    m = st.metrics()
+    assert m["queue_s"] == pytest.approx(1.0)
+    assert m["ttft_s"] == pytest.approx(1.5)
+    assert m["e2e_s"] == pytest.approx(1.7)
+    assert m["inter_token_s"] == [pytest.approx(0.2)]
+    assert m["new_tokens"] == 2 and not m["dropped"]
