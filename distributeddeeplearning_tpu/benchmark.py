@@ -249,6 +249,36 @@ def run_benchmark(
             policy.compute_dtype.itemsize if policy.mixed else None
         ),
     )
+    # Overlap telemetry (docs/OVERLAP.md): when the bucketed/streamed sync
+    # path is active, report the bucket partition's per-bucket wire bytes
+    # (after padding and the grad_comm codec) and a rough per-step overlap
+    # window: the backward time available for hiding all but the last
+    # bucket's collective. The window is an ESTIMATE from p50 step time —
+    # backward ~2/3 of a step, and the last of K buckets can't overlap
+    # anything — not a measured collective schedule; bench_overlap.py
+    # measures the realized fraction.
+    record["update_sharding"] = cfg.train.update_sharding
+    record["grad_bucket_mb"] = cfg.train.grad_bucket_mb
+    if cfg.train.grad_bucket_mb > 0 or cfg.train.update_sharding != "replicated":
+        import flax.linen as nn
+
+        from .comms_overlap import build_bucket_layout
+
+        layout = build_bucket_layout(
+            nn.meta.unbox(state.params),
+            cfg.train.grad_bucket_mb,
+            n_members=mesh.shape["dp"],
+            block_size=cfg.train.grad_comm_block,
+        )
+        record["grad_buckets"] = layout.num_buckets
+        record["grad_bucket_wire_bytes"] = layout.wire_bytes(
+            cfg.train.grad_comm, cfg.train.grad_comm_block
+        )
+        if "p50_step_ms" in record:
+            k = layout.num_buckets
+            record["overlap_window_ms"] = round(
+                record["p50_step_ms"] * (2.0 / 3.0) * (k - 1) / k, 3
+            )
     # Mixed-precision telemetry (docs/MIXED_PRECISION.md): the policy plus
     # the measured per-member DURABLE state footprint it governs (local
     # shard bytes: replicated leaves count fully, ZeRO-1 shards 1/N).

@@ -45,6 +45,19 @@ def build_all(cfg: Config, split: str = "train", devices=None,
     policy = check_precision_composition(
         cfg.train.precision.policy, optim_name=cfg.optim.name
     )
+    # Overlap/update-sharding x optimizer fences (comms_overlap.py): the
+    # Trainer only sees an opaque optax transformation, so the per-name
+    # optimizer checks (adamw_fused, weight_decay, grad_clip) live at the
+    # config seam — before the model build, like the precision fence above.
+    from .comms_overlap import check_update_sharding_config
+
+    check_update_sharding_config(
+        update_sharding=cfg.train.update_sharding,
+        grad_bucket_mb=cfg.train.grad_bucket_mb,
+        optim_name=cfg.optim.name,
+        weight_decay=cfg.optim.weight_decay,
+        grad_clip=cfg.optim.grad_clip,
+    )
     mesh = build_mesh(cfg.mesh, devices=devices)
     model = models.get_model(cfg.model.name, **cfg.model.kwargs)
     # Mesh-aware models (ring/Ulysses attention, pipelined stacks) need the
@@ -117,6 +130,8 @@ def build_all(cfg: Config, split: str = "train", devices=None,
         zero1=cfg.train.zero1,
         grad_comm=cfg.train.grad_comm,
         grad_comm_block=cfg.train.grad_comm_block,
+        grad_bucket_mb=cfg.train.grad_bucket_mb,
+        update_sharding=cfg.train.update_sharding,
         precision=policy,
         # Trainer gates on health.enabled itself; passing it unconditionally
         # keeps the TrainState schema (health field present/absent)

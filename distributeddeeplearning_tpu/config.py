@@ -176,6 +176,20 @@ class TrainConfig:
     # modes are pure-DP only in v1 (the Trainer fences compositions).
     grad_comm: str = "fp32"
     grad_comm_block: int = 256  # int8 quantization block size (elements)
+    # Overlapped gradient sync (comms_overlap.py; docs/OVERLAP.md): > 0
+    # partitions the grad pytree into ~this-many-MiB buckets in reverse
+    # layer order and fires one independent collective per bucket, so XLA
+    # can interleave sync with the remaining backward compute. 0 = off
+    # (single post-backward sync). Pure-DP only in v1 (Trainer fences).
+    grad_bucket_mb: float = 0.0
+    # Cross-replica weight-update sharding (arXiv 2004.13336): "sharded"
+    # turns grad sync + update into reduce-scatter -> each member updates
+    # its 1/dp flat param shard (optimizer state lives in that layout —
+    # ZeRO-1's endpoint) -> all-gather fresh params. "replicated" = the
+    # classic all-reduce + identical update everywhere. Fences: pure-DP,
+    # grad_accum=1, and optim weight_decay/grad_clip = 0 in v1
+    # (comms_overlap.check_update_sharding_config fails by name).
+    update_sharding: str = "replicated"
     # Mixed-precision policy block (precision.py; docs/MIXED_PRECISION.md).
     # Select with --override train.precision.policy=bf16 — NOT via
     # model.kwargs.dtype, which would train bf16 parameters with no fp32

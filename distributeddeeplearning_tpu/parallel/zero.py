@@ -73,6 +73,25 @@ def shard_opt_state_shardings(
     return jax.tree.map(rewrite, opt_shardings, abs_opt_state)
 
 
+def flat_opt_state_shardings(abs_opt_state, mesh: Mesh, axis: str = "dp"):
+    """Placements for the flat-shard optimizer state of
+    ``train.update_sharding='sharded'`` (comms_overlap.py).
+
+    Where :func:`shard_opt_state_shardings` (ZeRO-1) keeps per-parameter
+    moment trees and lets the partitioner rediscover reduce-scatter/
+    all-gather around the update, the sharded-update path stores moments
+    as per-bucket ``[dp, shard]`` flat stacks whose leading dimension IS
+    the membership: member ``i`` owns row ``i`` forever, the explicit
+    reduce-scatter feeds it, and no resharding ever happens. Scalar leaves
+    (step counts) replicate. This is ZeRO-1 taken to its endpoint — the
+    state never exists unsharded, so the flag composes trivially
+    (``zero1=True`` is implied).
+    """
+    from ..sharding import leading_dim_shardings
+
+    return leading_dim_shardings(abs_opt_state, mesh, axis=axis)
+
+
 def residual_shardings(abs_residual, mesh: Mesh, axis: str = "dp"):
     """NamedShardings for the error-feedback residual tree
     (``train.TrainState.grad_residual``, grad_comm in {int8, bf16}).

@@ -116,6 +116,29 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def leading_dim_shardings(abs_tree, mesh: Mesh, axis: str = "dp"):
+    """NamedShardings for per-member flat state: any leaf whose LEADING
+    dimension equals ``mesh.shape[axis]`` is sharded over ``axis`` on that
+    dimension; everything else (step counters, scalars) is replicated.
+
+    This is the layout of the flat-shard optimizer state under
+    ``train.update_sharding='sharded'`` (``comms_overlap.BucketLayout.
+    stacked_shards``: row ``i`` of a ``[n, shard]`` leaf is member ``i``'s
+    shard) and of the per-bucket error-feedback residuals — state that is
+    per-member by construction, where replication would both waste HBM and
+    be semantically wrong.
+    """
+    n = mesh.shape[axis]
+
+    def one(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 1 and shape[0] == n:
+            return NamedSharding(mesh, P(axis))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(one, abs_tree)
+
+
 # The mesh activation constraints resolve against. A package-local contextvar
 # (entered via ``activation_mesh``) rather than ``jax.sharding.set_mesh``:
 # flax's ``scope.param`` shape-validates every apply by eval_shape'ing the

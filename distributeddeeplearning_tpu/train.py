@@ -58,9 +58,11 @@ class TrainState:
     ``grad_residual`` is the error-feedback residual of the compressed
     gradient sync (``grad_comm`` in {int8, bf16}; see ``comms_quant.py``):
     per-parameter trees with a leading per-member dimension sharded over the
-    ``dp`` axis (``parallel/zero.residual_shardings``). ``None`` — and absent
-    from the pytree, so fp32 checkpoints are unchanged — when ``grad_comm``
-    is fp32.
+    ``dp`` axis (``parallel/zero.residual_shardings``). Under the overlapped
+    paths (``grad_bucket_mb``/``update_sharding`` — ``comms_overlap.py``)
+    the same bytes live as a tuple of per-BUCKET flat ``[dp, padded]``
+    buffers instead. ``None`` — and absent from the pytree, so fp32
+    checkpoints are unchanged — when ``grad_comm`` is fp32.
 
     ``health`` carries the on-device health guard's anomaly counters
     (``health.HealthState``; replicated scalars). Same None-when-disabled
@@ -436,6 +438,8 @@ class Trainer:
         allow_idle_axes: bool = False,
         grad_comm: str = "fp32",
         grad_comm_block: int = 256,
+        grad_bucket_mb: float = 0.0,
+        update_sharding: str = "replicated",
         precision: str | Policy = "fp32",
         health: Any = None,
         fault_nan_step: int | None = None,
@@ -493,6 +497,62 @@ class Trainer:
                 )
         self.grad_comm = grad_comm
         self.grad_comm_block = grad_comm_block
+        # Overlapped bucketed sync + cross-replica weight-update sharding
+        # (comms_overlap.py). Either knob routes the step through
+        # _overlapped_dp_step_fn, which owns ALL wire modes (fp32 included)
+        # per bucket — so the same pure-DP fences as the quantized path
+        # apply: the explicit shard_map over 'dp' does not reproduce the
+        # partitioner's interleaved param-gather collectives of fsdp/tp/
+        # pp/cp/ep, and grad_accum would need residuals + buckets threaded
+        # through the microbatch scan. Optimizer-level fences (weight_decay/
+        # grad_clip/adamw_fused x sharded) are config-time in
+        # comms_overlap.check_update_sharding_config via cli.build_all.
+        from .comms_overlap import UPDATE_SHARDING_MODES
+
+        if update_sharding not in UPDATE_SHARDING_MODES:
+            raise ValueError(
+                f"update_sharding={update_sharding!r} not in "
+                f"{UPDATE_SHARDING_MODES}"
+            )
+        if grad_bucket_mb < 0:
+            raise ValueError(
+                f"grad_bucket_mb={grad_bucket_mb} must be >= 0"
+            )
+        self.update_sharding = update_sharding
+        self.grad_bucket_mb = float(grad_bucket_mb)
+        self._overlap = (
+            self.grad_bucket_mb > 0 or update_sharding == "sharded"
+        )
+        if self._overlap:
+            knobs = (
+                f"grad_bucket_mb={grad_bucket_mb}"
+                if self.grad_bucket_mb > 0
+                else f"update_sharding={update_sharding!r}"
+            )
+            if hasattr(model, "num_stages"):
+                raise NotImplementedError(
+                    f"{knobs} x pipelined model {type(model).__name__} is "
+                    "unsupported in v1: the pipeline engine computes grads "
+                    "inside its schedule — use grad_bucket_mb=0 and "
+                    "update_sharding='replicated'"
+                )
+            busy = {
+                a: mesh.shape[a]
+                for a in ("fsdp", "tp", "pp", "cp", "ep")
+                if mesh.shape[a] > 1
+            }
+            if busy:
+                raise NotImplementedError(
+                    f"{knobs} is pure-DP in v1 but the mesh has {busy}: "
+                    "bucketed/sharded sync composes with dp/zero1 only"
+                )
+            if grad_accum > 1:
+                raise NotImplementedError(
+                    f"{knobs} x grad_accum={grad_accum} is unsupported in "
+                    "v1: per-bucket collectives (and EF residuals) would "
+                    "need threading through the microbatch scan"
+                )
+        self._layout = None
         # Mixed-precision policy (precision.py): fp32 masters in TrainState,
         # a compute copy cast per step. Model-facing fences live here (the
         # config-time optimizer fence is check_precision_composition).
@@ -580,6 +640,22 @@ class Trainer:
 
     # -- init ---------------------------------------------------------------
 
+    def _bucket_layout_for(self, params):
+        """The (cached) static bucket partition of the param pytree for the
+        overlapped paths — pure shape math, safe to call on tracers or
+        abstract params (``build_bucket_layout`` reads only shapes/dtypes,
+        which are identical everywhere the Trainer sees this tree)."""
+        if self._layout is None:
+            from . import comms_overlap
+
+            self._layout = comms_overlap.build_bucket_layout(
+                nn.meta.unbox(params),
+                self.grad_bucket_mb,
+                n_members=self.mesh.shape["dp"],
+                block_size=self.grad_comm_block,
+            )
+        return self._layout
+
     def _init_fn(self, rng, example_inputs):
         p_rng, d_rng, s_rng = jax.random.split(rng, 3)
         with nn.logical_axis_rules(self.rules):
@@ -590,17 +666,36 @@ class Trainer:
         # sow()-collections are per-step outputs, not persistent state.
         variables.pop("losses", None)
         variables.pop("metrics", None)
-        opt_state = self.tx.init(params)
+        if self.update_sharding == "sharded":
+            # Flat-shard optimizer state (comms_overlap.py): tx.init runs
+            # on the [dp, shard] stacked flat view of the params, so the
+            # moments are BORN in the per-member layout the reduce-scatter
+            # feeds — they never exist unsharded (arXiv 2004.13336).
+            layout = self._bucket_layout_for(params)
+            opt_state = self.tx.init(
+                layout.stacked_shards(nn.meta.unbox(params))
+            )
+        else:
+            opt_state = self.tx.init(params)
         grad_residual = None
         if self.grad_comm != "fp32":
             # EF residual: one f32 copy of the params PER dp member (leading
             # device dim, sharded over 'dp' — see setup()). Unboxed so the
-            # logical-rules pass leaves it alone.
+            # logical-rules pass leaves it alone. The overlapped path keeps
+            # its residuals per BUCKET (flat [dp, padded] buffers — the
+            # granularity its codec compresses at) instead of per parameter.
             dp = self.mesh.shape["dp"]
-            grad_residual = jax.tree.map(
-                lambda p: jnp.zeros((dp, *jnp.shape(p)), jnp.float32),
-                nn.meta.unbox(params),
-            )
+            if self._overlap:
+                from . import comms_overlap
+
+                grad_residual = comms_overlap.zeros_bucket_residuals(
+                    self._bucket_layout_for(params), dp
+                )
+            else:
+                grad_residual = jax.tree.map(
+                    lambda p: jnp.zeros((dp, *jnp.shape(p)), jnp.float32),
+                    nn.meta.unbox(params),
+                )
         health_state = None
         if self.health is not None:
             from .health import init_health_state
@@ -638,7 +733,29 @@ class Trainer:
         specs = nn.get_partition_spec(abs_state)
         self.abstract_state = nn.meta.unbox(abs_state)
         self.state_shardings = logical_to_mesh_sharding(specs, self.mesh, self.rules)
-        if self.zero1:
+        if self.update_sharding == "sharded":
+            from .ops.fused_adamw import FusedAdamWState
+            from .parallel.zero import flat_opt_state_shardings
+
+            if isinstance(self.abstract_state.opt_state, FusedAdamWState):
+                # Direct-Trainer users bypass cli.build_all's config fence;
+                # the state TYPE is the first point the Trainer can see the
+                # fused kernel. Same failure, still before any compile.
+                raise NotImplementedError(
+                    "update_sharding='sharded' x adamw_fused is unsupported "
+                    "in v1: the fused kernel has its own per-leaf shard_map "
+                    "dispatch (_tx_update) — use optimizer 'adamw' or "
+                    "update_sharding='replicated'"
+                )
+            # Flat [dp, shard] moments: leading dim IS the membership.
+            # zero1=True is subsumed (the state never exists unsharded),
+            # so the flag composes as a no-op rather than a conflict.
+            self.state_shardings = self.state_shardings.replace(
+                opt_state=flat_opt_state_shardings(
+                    self.abstract_state.opt_state, self.mesh
+                )
+            )
+        elif self.zero1:
             from .parallel.zero import shard_opt_state_shardings
 
             self.state_shardings = self.state_shardings.replace(
@@ -648,7 +765,9 @@ class Trainer:
                     self.mesh,
                 )
             )
-            if self.precision.mixed and self.grad_comm == "fp32":
+            if self.precision.mixed and self.grad_comm == "fp32" and (
+                not self._overlap
+            ):
                 # ZeRO-1 x mixed precision = weight-update sharding done
                 # right (cf. "Automatic Cross-Replica Sharding of Weight
                 # Update in Data-Parallel Training"): shard the fp32
@@ -657,10 +776,11 @@ class Trainer:
                 # all-gather of the *compute-dtype copy* (the elementwise
                 # cast preserves the sharded layout, so the partitioner
                 # gathers bf16 — half the bytes of gathering fp32 masters).
-                # Skipped under lossy grad_comm: its shard_map body takes
-                # params with their rules-derived (replicated-over-dp)
-                # in_specs, and dp-sharded masters would be resharded back
-                # every step for no win.
+                # Skipped under lossy grad_comm AND the overlapped paths:
+                # those shard_map bodies take params with their
+                # rules-derived (replicated-over-dp) in_specs, and
+                # dp-sharded masters would be resharded back every step
+                # for no win.
                 self.state_shardings = self.state_shardings.replace(
                     params=shard_opt_state_shardings(
                         self.state_shardings.params,
@@ -992,6 +1112,205 @@ class Trainer:
 
         return step_fn
 
+    def _overlapped_dp_step_fn(self):
+        """Bucketed/overlapped gradient sync, optionally with cross-replica
+        weight-update sharding (comms_overlap.py; docs/OVERLAP.md).
+
+        Like :meth:`_quantized_dp_step_fn`, the whole loss-and-grad runs
+        under ``shard_map`` over the mesh — but the sync is one INDEPENDENT
+        collective per reverse-layer-order bucket, so XLA's scheduler can
+        issue bucket k's collective while the backward dots feeding buckets
+        k+1.. are still running (tests/test_overlap.py pins this in the
+        scheduled HLO).
+
+        ``update_sharding='replicated'``: per-bucket all-reduce; the
+        optimizer update stays OUTSIDE the shard_map on the replicated
+        synced grads — ``_instrument_grads``/``_tx_update``/ZeRO-1 dispatch
+        unchanged.
+
+        ``update_sharding='sharded'`` (arXiv 2004.13336): per-bucket
+        reduce-scatter; INSIDE the body each member slices its 1/dp flat
+        param shard, advances its flat-shard optimizer state (born in that
+        layout — ``_init_fn``), and a per-bucket all-gather rebuilds the
+        replicated params. Gradient instrumentation (NaN fault injection,
+        the guard's grad-norm) moves inside too, on the shard view — the
+        psum of per-shard square sums reproduces exactly the global norm
+        the replicated path computes. The compiled step contains
+        reduce-scatter + all-gather over 'dp' and NO full-gradient
+        all-reduce.
+
+        Returns the same ``(state, batch) -> (state, metrics)`` body as
+        every other step fn, so the health-guard wrap and the fused K-step
+        scan compose unchanged.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from . import comms_overlap
+        from .mesh import BATCH_AXES
+
+        mode = self.grad_comm
+        block = self.grad_comm_block
+        n = self.mesh.shape["dp"]
+        lossy = mode != "fp32"
+        layout = self._bucket_layout_for(self.abstract_state.params)
+        param_specs = jax.tree.map(
+            lambda s: s.spec, self.state_shardings.params
+        )
+        mstate_specs = jax.tree.map(
+            lambda s: s.spec, self.state_shardings.model_state
+        )
+
+        def loss_and_local_grads(params, model_state, batch, rng):
+            # Shared front half of both variants: per-member rng, compute
+            # cast, local-batch loss + grads, fp32 grads for the wire.
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+            cparams = cast_to_compute(self.precision, params)
+            (_, (metrics, updates)), grads = jax.value_and_grad(
+                self._loss_and_updates, has_aux=True
+            )(cparams, model_state, batch, rng, True)
+            grads = cast_grads_to_update(self.precision, grads)
+            metrics = jax.tree.map(lambda m: jax.lax.pmean(m, "dp"), metrics)
+            updates = jax.tree.map(
+                lambda u: (
+                    jax.lax.pmean(u, "dp")
+                    if jnp.issubdtype(u.dtype, jnp.inexact) else u
+                ),
+                updates,
+            )
+            return grads, metrics, updates
+
+        if self.update_sharding == "replicated":
+
+            def sync_body(params, model_state, batch, rng, residual):
+                grads, metrics, updates = loss_and_local_grads(
+                    params, model_state, batch, rng
+                )
+                res = [r[0] for r in residual] if lossy else None
+                summed, new_res = comms_overlap.bucketed_all_reduce(
+                    grads, layout, "dp",
+                    mode=mode, block_size=block, residuals=res,
+                )
+                grads = jax.tree.map(lambda g: g / n, summed)
+                new_res = tuple(r[None] for r in new_res) if lossy else ()
+                return grads, metrics, updates, new_res
+
+            sync = compat.shard_map(
+                sync_body,
+                mesh=self.mesh,
+                in_specs=(
+                    param_specs, mstate_specs, P(BATCH_AXES), P(), P("dp"),
+                ),
+                out_specs=(param_specs, P(), mstate_specs, P("dp")),
+                check_vma=False,
+            )
+
+            def step_fn(state: TrainState, batch):
+                rng = fold_in_step(state.rng, state.step)
+                residual = state.grad_residual if lossy else ()
+                grads, metrics, updates, new_res = sync(
+                    state.params, state.model_state, batch, rng, residual
+                )
+                grads, metrics = self._instrument_grads(
+                    grads, state.step, metrics
+                )
+                updates_tx, new_opt_state = self._tx_update(
+                    grads, state.opt_state, state.params
+                )
+                new_params = optax.apply_updates(state.params, updates_tx)
+                new_state = state.replace(
+                    step=state.step + 1,
+                    params=new_params,
+                    opt_state=new_opt_state,
+                    model_state=updates,
+                    grad_residual=new_res if lossy else None,
+                )
+                return new_state, metrics
+
+            return step_fn
+
+        # update_sharding == "sharded"
+        opt_specs = jax.tree.map(
+            # Flat stacks [dp, shard] carry the membership on dim 0; the
+            # only other leaves an elementwise optax state can hold are
+            # scalars (counts), replicated.
+            lambda a: P("dp") if getattr(a, "ndim", 0) == 2 else P(),
+            self.abstract_state.opt_state,
+        )
+
+        def sync_body(params, model_state, batch, rng, residual, opt_state,
+                      step):
+            grads, metrics, updates = loss_and_local_grads(
+                params, model_state, batch, rng
+            )
+            res = [r[0] for r in residual] if lossy else None
+            shard_grads, new_res = comms_overlap.bucketed_reduce_scatter(
+                grads, layout, "dp",
+                mode=mode, block_size=block, residuals=res,
+            )
+            shard_grads = tuple(g / n for g in shard_grads)
+            # _instrument_grads, shard-view edition: poison first, then the
+            # norm, so the guard detects exactly what the optimizer eats.
+            # sum-of-psum-of-shard-squares == the replicated global norm
+            # (the zero padding tail contributes zero).
+            if self.fault_nan_step is not None:
+                bad = step == self.fault_nan_step
+                shard_grads = tuple(
+                    jnp.where(bad, jnp.full(g.shape, jnp.nan, g.dtype), g)
+                    for g in shard_grads
+                )
+            if self.health is not None:
+                sq = sum(jnp.sum(jnp.square(g)) for g in shard_grads)
+                metrics = {
+                    **metrics,
+                    "grad_norm": jnp.sqrt(jax.lax.psum(sq, "dp")),
+                }
+            i = jax.lax.axis_index("dp")
+            param_shards = layout.local_shards(params, i)
+            opt_local = jax.tree.map(
+                lambda x: x[0] if x.ndim == 2 else x, opt_state
+            )
+            upd, new_opt = self.tx.update(
+                shard_grads, opt_local, param_shards
+            )
+            new_shards = optax.apply_updates(param_shards, upd)
+            new_params = comms_overlap.all_gather_buckets(
+                new_shards, layout, "dp"
+            )
+            new_opt = jax.tree.map(
+                lambda x: x[None] if x.ndim == 1 else x, new_opt
+            )
+            new_res = tuple(r[None] for r in new_res) if lossy else ()
+            return new_params, metrics, updates, new_res, new_opt
+
+        sync = compat.shard_map(
+            sync_body,
+            mesh=self.mesh,
+            in_specs=(
+                param_specs, mstate_specs, P(BATCH_AXES), P(), P("dp"),
+                opt_specs, P(),
+            ),
+            out_specs=(param_specs, P(), mstate_specs, P("dp"), opt_specs),
+            check_vma=False,
+        )
+
+        def step_fn(state: TrainState, batch):
+            rng = fold_in_step(state.rng, state.step)
+            residual = state.grad_residual if lossy else ()
+            new_params, metrics, updates, new_res, new_opt = sync(
+                state.params, state.model_state, batch, rng, residual,
+                state.opt_state, state.step,
+            )
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                model_state=updates,
+                grad_residual=new_res if lossy else None,
+            )
+            return new_state, metrics
+
+        return step_fn
+
     def _plain_step_fn(self):
         def step_fn(state: TrainState, batch):
             rng = fold_in_step(state.rng, state.step)
@@ -1083,6 +1402,11 @@ class Trainer:
             getattr(self.model, "pipeline", True)
         ):
             fn, meshed = self._pipeline_step_fn(), True
+        elif self._overlap:
+            # Bucketed and/or sharded-update sync: owns every wire mode
+            # (fp32 included) per bucket. Manual-mode shard_map body, like
+            # the quantized path below.
+            fn, meshed = self._overlapped_dp_step_fn(), False
         elif self.grad_comm != "fp32":
             # Manual-mode body (shard_map): ``sharding.constrain`` must stay
             # a no-op, so no MeshedJit (see _quantized_dp_step_fn).

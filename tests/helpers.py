@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import math
 import os
+import re
 import subprocess
 import sys
 
@@ -186,6 +187,50 @@ def sync_wire_bytes(text: str, n: int) -> float:
             if group >= n // 2:
                 total += factors.get(kind, (n - 1) / n) * payload
     return total
+
+
+def dp_group_payloads(text: str, n: int, kind: str) -> list[int]:
+    """Sorted payload bytes of every full-dp-group collective of ``kind``
+    in HLO text. Scalar/control collectives (metric psums, health-guard
+    flags) ride along in any step program — callers threshold on payload
+    to separate them from gradient traffic."""
+    from distributeddeeplearning_tpu.utils.hlo import collective_bytes
+
+    return sorted(p for p, g in collective_bytes(text, n).get(kind, ()) if g == n)
+
+
+def entry_schedule(text: str, *, min_payload: int) -> tuple[list[int], list[int]]:
+    """Schedule-order view of the OPTIMIZED module's ENTRY computation:
+    ``(all_reduce_lines, compute_lines)`` — line indices of all-reduces
+    carrying at least ``min_payload`` bytes and of compute ops (fusions /
+    dots / convolutions). The CPU backend prints the entry computation in
+    its final thunk schedule order, so "compute lines between the first and
+    last gradient all-reduce" is exactly the overlap window the bucketed
+    sync path exists to open (docs/OVERLAP.md)."""
+    from distributeddeeplearning_tpu.utils.hlo import _OP_LINE, _type_bytes
+
+    entry: list[str] = []
+    inside = False
+    for line in text.splitlines():
+        if line.startswith("ENTRY"):
+            inside = True
+            continue
+        if inside:
+            if line.startswith("}"):
+                break
+            entry.append(line)
+    assert entry, "no ENTRY computation found in HLO text"
+    ar_lines, compute_lines = [], []
+    compute = re.compile(r"= .* (fusion|dot|convolution)(\.[0-9]+)?\(")
+    for i, line in enumerate(entry):
+        m = _OP_LINE.search(line)
+        if m and m.group("kind") == "all-reduce":
+            payload = _type_bytes(m.group("type"), start_op=bool(m.group("start")))
+            if payload >= min_payload:
+                ar_lines.append(i)
+        elif compute.search(line):
+            compute_lines.append(i)
+    return ar_lines, compute_lines
 
 
 def train_tiny_gpt2(

@@ -216,3 +216,158 @@ def test_config_path_rejects_dtype_policy_conflict():
     )
     with pytest.raises(ValueError, match="the policy owns the compute dtype"):
         build_all(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Overlapped gradient sync / sharded weight update (train.grad_bucket_mb,
+# train.update_sharding) x everything else
+#
+# The matrix docs/OVERLAP.md promises: both knobs are pure-DP v1 features —
+# the pairs they cannot serve must fail at build time naming the knob, the
+# pairs they can (zero1, lossy wire, precision policies, health guard) must
+# build (their numerics are pinned in test_overlap.py).
+# ---------------------------------------------------------------------------
+
+
+def _overlap_trainer(mesh, model=None, optim="adamw", **kw):
+    if model is None:
+        model = models.get_model(
+            "gpt2", size="tiny", vocab_size=64, max_len=32, dropout_rate=0.0
+        )
+    return Trainer(
+        model, make_optimizer(optim, 1e-3), get_task("lm"), mesh,
+        donate=False, **kw,
+    )
+
+
+@pytest.mark.parametrize(
+    "knob", [dict(grad_bucket_mb=1.0), dict(update_sharding="sharded")],
+    ids=["bucketed", "sharded"],
+)
+def test_overlap_rejects_pipelined_model(knob):
+    mesh = mesh_of(dp=2, pp=2)
+    model = models.get_model(
+        "gpt2_pp", size="tiny", vocab_size=64, max_len=32,
+        num_stages=2, num_microbatches=2, mesh=mesh,
+    )
+    name = next(iter(knob))
+    with pytest.raises(NotImplementedError, match=f"{name}.*pipelined"):
+        _overlap_trainer(mesh, model=model, **knob)
+
+
+@pytest.mark.parametrize(
+    "knob", [dict(grad_bucket_mb=1.0), dict(update_sharding="sharded")],
+    ids=["bucketed", "sharded"],
+)
+def test_overlap_rejects_busy_model_axes(knob):
+    mesh = mesh_of(dp=4, fsdp=2)
+    with pytest.raises(NotImplementedError, match="pure-DP"):
+        _overlap_trainer(mesh, **knob)
+
+
+def test_overlap_rejects_grad_accum():
+    with pytest.raises(NotImplementedError, match="grad_bucket_mb.*grad_accum"):
+        _overlap_trainer(mesh_of(dp=8), grad_bucket_mb=1.0, grad_accum=2)
+
+
+def test_overlap_rejects_bad_mode_and_negative_bucket():
+    with pytest.raises(ValueError, match="update_sharding"):
+        _overlap_trainer(mesh_of(dp=8), update_sharding="zero3")
+    with pytest.raises(ValueError, match="grad_bucket_mb"):
+        _overlap_trainer(mesh_of(dp=8), grad_bucket_mb=-0.5)
+
+
+def test_sharded_setup_rejects_fused_adamw_state():
+    # Direct-Trainer users bypass the cli config fence; the optimizer STATE
+    # type at setup is the Trainer's first sight of the fused kernel.
+    from distributeddeeplearning_tpu import data as data_lib
+
+    tr = _overlap_trainer(
+        mesh_of(dp=8), optim="adamw_fused", update_sharding="sharded"
+    )
+    ds = data_lib.SyntheticTokens(
+        batch_size=8, seq_len=16, vocab_size=64, seed=0, n_distinct=4
+    )
+    with pytest.raises(NotImplementedError, match="adamw_fused"):
+        tr.setup(ds.batch(0))
+
+
+@pytest.mark.parametrize(
+    "extra_overrides, match",
+    [
+        ([], "adamw_fused"),
+        (["optim.name=adamw"], "weight_decay"),
+        (["optim.name=adamw", "optim.weight_decay=0.0"], "grad_clip"),
+    ],
+    ids=["fused-kernel", "weight-decay", "grad-clip"],
+)
+def test_cli_fences_sharded_update_by_optimizer_feature(extra_overrides, match):
+    # gpt2_owt ships adamw_fused + weight_decay + grad_clip — peeling them
+    # off one override at a time must hit each fence by name.
+    import os
+
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import apply_overrides, load_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = apply_overrides(
+        load_config(os.path.join(repo, "configs", "gpt2_owt.py")),
+        ["train.update_sharding=sharded"] + extra_overrides,
+    )
+    with pytest.raises(NotImplementedError, match=match):
+        build_all(cfg)
+
+
+def test_cli_threads_overlap_knobs():
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import (
+        Config, DataConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig,
+    )
+
+    cfg = Config(
+        model=ModelConfig(
+            name="gpt2",
+            kwargs=dict(size="tiny", vocab_size=128, max_len=32,
+                        dropout_rate=0.0),
+        ),
+        data=DataConfig(kind="synthetic_tokens", batch_size=8, seq_len=16,
+                        vocab_size=128),
+        optim=OptimConfig(name="adamw", lr=1e-3),
+        train=TrainConfig(steps=1, task="lm", update_sharding="sharded",
+                          grad_bucket_mb=0.25),
+        mesh=MeshConfig(dp=-1),
+    )
+    _, _, trainer, _ = build_all(cfg)
+    assert trainer.update_sharding == "sharded"
+    assert trainer.grad_bucket_mb == 0.25
+
+
+@pytest.mark.parametrize(
+    "trainer_kw",
+    [
+        dict(update_sharding="sharded", zero1=True),
+        dict(update_sharding="sharded", grad_comm="int8"),
+        dict(grad_bucket_mb=0.5, grad_comm="bf16"),
+        dict(grad_bucket_mb=0.5, fault_nan_step=1),
+    ],
+    ids=["sharded-zero1", "sharded-int8", "bucketed-bf16",
+         "bucketed-fault-injection"],
+)
+def test_overlap_legal_pairs_build(trainer_kw):
+    _overlap_trainer(mesh_of(dp=8), **trainer_kw)
+
+
+def test_overlap_composes_with_precision_policy():
+    _precision_trainer(
+        _bf16_model(), mesh_of(dp=8), update_sharding="sharded"
+    )
+    _precision_trainer(_bf16_model(), mesh_of(dp=8), grad_bucket_mb=0.5)
+
+
+def test_overlap_composes_with_health_guard():
+    from distributeddeeplearning_tpu.config import HealthConfig
+
+    _overlap_trainer(
+        mesh_of(dp=8), update_sharding="sharded",
+        health=HealthConfig(enabled=True),
+    )

@@ -128,6 +128,25 @@ def test_measured_base_present_only_with_silicon_record(shrunk):
     assert 0 < eff <= 1
 
 
+def test_measured_overlap_feeds_projection(shrunk):
+    # The measured overlap fraction (BENCH_OVERLAP.json, docs/OVERLAP.md)
+    # replaces the assumed full-overlap number: when the bench artifact is
+    # present, every projection with a measured compute base carries a
+    # measured-overlap efficiency bracketed by the two bounds.
+    mo = shrunk["measured_overlap"]
+    if mo["fraction"] is None:
+        assert mo["reason"]  # absence is named, never silent
+        pytest.skip("BENCH_OVERLAP.json not generated")
+    assert 0.0 <= mo["fraction"] <= 1.0
+    assert "BENCH_OVERLAP.json" in mo["source"]
+    rn = shrunk["scenarios"][0]  # resnet50 has the silicon compute base
+    for proj in rn["projections"]:
+        eff = proj["scaling_efficiency_measured_overlap"]
+        assert (proj["scaling_efficiency_no_overlap"]
+                <= eff
+                <= proj["scaling_efficiency_full_overlap"])
+
+
 def test_committed_artifact_is_full_size():
     if not os.path.exists(_ARTIFACT):
         pytest.skip("PROJECTED_SCALING.json not yet generated")
