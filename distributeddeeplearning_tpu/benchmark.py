@@ -259,7 +259,21 @@ def run_benchmark(
     # measures the realized fraction.
     record["update_sharding"] = cfg.train.update_sharding
     record["grad_bucket_mb"] = cfg.train.grad_bucket_mb
-    if cfg.train.grad_bucket_mb > 0 or cfg.train.update_sharding != "replicated":
+    # Multi-slice telemetry (comms_hier.py; docs/MULTISLICE.md): the
+    # hierarchy knob as resolved plus the DCN-byte picture.
+    # dcn_wire_bytes is the per-member payload that actually crosses
+    # slices per step: under a flat sync on a hybrid mesh the ring spans
+    # slices, so the FULL sync traffic rides DCN; under the hierarchical
+    # path only the cross-slice all-reduce of the 1/ici shard does.
+    from .comms_hier import HierTopology, phase_wire_bytes, resolve_hierarchy
+
+    use_hier = resolve_hierarchy(cfg.train.comm_hierarchy, cfg.mesh.dcn_dp)
+    record["comm_hierarchy"] = (
+        "hierarchical" if use_hier else "flat"
+    )
+    record["dcn_dp"] = cfg.mesh.dcn_dp
+    if (cfg.train.grad_bucket_mb > 0
+            or cfg.train.update_sharding != "replicated" or use_hier):
         import flax.linen as nn
 
         from .comms_overlap import build_bucket_layout
@@ -279,6 +293,17 @@ def run_benchmark(
             record["overlap_window_ms"] = round(
                 record["p50_step_ms"] * (2.0 / 3.0) * (k - 1) / k, 3
             )
+        if use_hier:
+            topo = HierTopology(n=mesh.shape["dp"], dcn=cfg.mesh.dcn_dp)
+            phases = phase_wire_bytes(
+                sum(record["grad_bucket_wire_bytes"]), topo
+            )
+            record["hier_phase_wire_bytes"] = phases
+            record["dcn_wire_bytes"] = phases["cross_all_reduce_bytes"]
+    if not use_hier:
+        record["dcn_wire_bytes"] = (
+            record["grad_sync_bytes_per_step"] if cfg.mesh.dcn_dp > 1 else 0
+        )
     # Mixed-precision telemetry (docs/MIXED_PRECISION.md): the policy plus
     # the measured per-member DURABLE state footprint it governs (local
     # shard bytes: replicated leaves count fully, ZeRO-1 shards 1/N).

@@ -58,6 +58,14 @@ def build_all(cfg: Config, split: str = "train", devices=None,
         weight_decay=cfg.optim.weight_decay,
         grad_clip=cfg.optim.grad_clip,
     )
+    # Hierarchy x topology fence (comms_hier.py): mode-name and dcn_dp
+    # sanity here, before the mesh build; the dp-divisibility check runs in
+    # the Trainer where the resolved dp extent is known.
+    from .comms_hier import check_comm_hierarchy_config
+
+    check_comm_hierarchy_config(
+        comm_hierarchy=cfg.train.comm_hierarchy, dcn_dp=cfg.mesh.dcn_dp
+    )
     mesh = build_mesh(cfg.mesh, devices=devices)
     model = models.get_model(cfg.model.name, **cfg.model.kwargs)
     # Mesh-aware models (ring/Ulysses attention, pipelined stacks) need the
@@ -132,6 +140,8 @@ def build_all(cfg: Config, split: str = "train", devices=None,
         grad_comm_block=cfg.train.grad_comm_block,
         grad_bucket_mb=cfg.train.grad_bucket_mb,
         update_sharding=cfg.train.update_sharding,
+        dcn_dp=cfg.mesh.dcn_dp,
+        comm_hierarchy=cfg.train.comm_hierarchy,
         precision=policy,
         # Trainer gates on health.enabled itself; passing it unconditionally
         # keeps the TrainState schema (health field present/absent)
@@ -536,11 +546,113 @@ def cmd_supervise(args) -> int:
     return supervise_command(cmd, cfg.supervisor, crash_clear_paths=clear)
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _launch_plan(config: str, overrides: list[str], num_processes: int,
+                 *, devices_per_process: int = 0, coordinator_port: int = 0,
+                 xla_perf_flags: bool = False, base_env: dict | None = None):
+    """``[(cmd, env), ...]`` for every child of ``cli launch`` — pure
+    (no processes spawned), so tests can pin the plan.
+
+    Children are plain ``cli train`` invocations; the multiprocess runtime
+    is threaded ENTIRELY through the env vars ``mesh.init_distributed``
+    already consumes (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID), so
+    a launched child and a manually started pod worker take the exact same
+    code path. ``devices_per_process > 0`` additionally pins that many
+    SIMULATED CPU devices per child (utils.compat.set_cpu_device_env) — the
+    multiprocess CPU backend used for multi-slice rehearsal
+    (docs/MULTISLICE.md); 0 leaves device discovery to the runtime (real
+    TPU hosts)."""
+    import os
+
+    if num_processes < 2:
+        raise ValueError(
+            f"--num-processes={num_processes}: a multiprocess launch needs "
+            ">= 2 (single-process runs don't need the launcher)"
+        )
+    port = coordinator_port or _free_port()
+    cmd = [
+        sys.executable, "-m", "distributeddeeplearning_tpu.cli",
+        "train", "--config", config,
+    ]
+    for o in overrides:
+        cmd += ["--override", o]
+    if xla_perf_flags:
+        cmd.append("--xla-perf-flags")
+    plan = []
+    for pid in range(num_processes):
+        env = dict(os.environ if base_env is None else base_env)
+        env["COORDINATOR_ADDRESS"] = f"localhost:{port}"
+        env["NUM_PROCESSES"] = str(num_processes)
+        env["PROCESS_ID"] = str(pid)
+        if devices_per_process > 0:
+            from .utils.compat import set_cpu_device_env
+
+            env["JAX_PLATFORMS"] = "cpu"
+            set_cpu_device_env(env, devices_per_process)
+        plan.append((list(cmd), env))
+    return plan
+
+
+def _stream_prefixed(stream, prefix: str, out) -> None:
+    """Copy ``stream`` to ``out`` line-by-line with a ``[pK] `` prefix, so
+    the interleaved stdout of N children (log lines AND JSON events) stays
+    attributable to its process."""
+    for line in iter(stream.readline, ""):
+        out.write(prefix + line)
+        out.flush()
+    stream.close()
+
+
+def cmd_launch(args) -> int:
+    """Spawn ``--num-processes`` coordinated ``cli train`` workers on this
+    machine (docs/MULTISLICE.md). The launcher itself never touches the
+    accelerator — like ``supervise``, it runs BEFORE ``init_distributed``
+    so the backend and coordinator port belong to the children. Exit code
+    is the max over children (0 only when every worker succeeded)."""
+    import subprocess
+    import threading
+
+    plan = _launch_plan(
+        args.config, args.override, args.num_processes,
+        devices_per_process=args.devices_per_process,
+        coordinator_port=args.coordinator_port,
+        xla_perf_flags=args.xla_perf_flags,
+    )
+    procs, threads = [], []
+    for pid, (cmd, env) in enumerate(plan):
+        p = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        t = threading.Thread(
+            target=_stream_prefixed,
+            args=(p.stdout, f"[p{pid}] ", sys.stdout),
+            daemon=True,
+        )
+        t.start()
+        procs.append(p)
+        threads.append(t)
+    rcs = [p.wait() for p in procs]
+    for t in threads:
+        t.join(timeout=5)
+    for pid, rc in enumerate(rcs):
+        if rc:
+            print(f"[launch] process {pid} exited {rc}", file=sys.stderr)
+    return max(rcs)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="distributeddeeplearning_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
     for name in ("train", "eval", "benchmark", "generate", "serve",
-                 "supervise"):
+                 "supervise", "launch"):
         p = sub.add_parser(name)
         p.add_argument("--config", required=True, help="path to a config .py")
         p.add_argument(
@@ -574,11 +686,31 @@ def main(argv=None) -> int:
                 help="re-run the compiled decode loop once and report "
                 "steady-state tokens/sec",
             )
+        if name == "launch":
+            p.add_argument(
+                "--num-processes", type=int, required=True,
+                help="coordinated train workers to spawn (>= 2)",
+            )
+            p.add_argument(
+                "--devices-per-process", type=int, default=0,
+                help="pin this many SIMULATED CPU devices per worker "
+                "(multiprocess CPU backend rehearsal); 0 = let the "
+                "runtime discover real devices",
+            )
+            p.add_argument(
+                "--coordinator-port", type=int, default=0,
+                help="jax.distributed coordinator port (0 = pick a free "
+                "one)",
+            )
     args = parser.parse_args(argv)
     if args.cmd == "supervise":
         # BEFORE init_distributed: the supervisor must not claim the backend
         # or the coordinator port its children need.
         return cmd_supervise(args)
+    if args.cmd == "launch":
+        # Same reason: the launcher is a pure process babysitter — the
+        # backend and coordinator rendezvous belong to its children.
+        return cmd_launch(args)
     if args.xla_perf_flags:
         # Env-level, so it must precede EVERY backend touch — including the
         # rendezvous below and anything a config module might do.

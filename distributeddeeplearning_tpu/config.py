@@ -190,6 +190,14 @@ class TrainConfig:
     # grad_accum=1, and optim weight_decay/grad_clip = 0 in v1
     # (comms_overlap.check_update_sharding_config fails by name).
     update_sharding: str = "replicated"
+    # Hierarchical ICI+DCN gradient sync (comms_hier.py;
+    # docs/MULTISLICE.md): on a hybrid mesh (mesh.dcn_dp > 1) decompose
+    # each bucket's gradient collective into intra-slice reduce-scatter ->
+    # cross-slice all-reduce of the 1/ici shard (the only DCN traffic) ->
+    # intra-slice all-gather. "auto" (default) picks hierarchical exactly
+    # when mesh.dcn_dp > 1; "flat"/"hierarchical" force. Pure-DP only in
+    # v1 (comms_hier.check_comm_hierarchy_config fails by name).
+    comm_hierarchy: str = "auto"
     # Mixed-precision policy block (precision.py; docs/MIXED_PRECISION.md).
     # Select with --override train.precision.policy=bf16 — NOT via
     # model.kwargs.dtype, which would train bf16 parameters with no fp32

@@ -113,7 +113,25 @@ def build_mesh(
             arr = mesh_utils.create_hybrid_device_mesh(
                 ici_shape, dcn_shape, devices=devices
             )
-        except Exception as e:  # no slice metadata (CPU sim) -> order-preserving
+        except Exception as e:
+            # The reshape fallback is ONLY sound on the CPU sim, where
+            # enumeration order IS the simulated topology (dcn_dp groups
+            # consecutive devices into slices — the member-numbering
+            # contract comms_hier.HierTopology builds its replica groups
+            # on). On real accelerators the hybrid builder failing means
+            # slice metadata is missing/inconsistent; an enumeration-order
+            # reshape would silently route intra-slice collectives over
+            # DCN (and cross-slice ones over ICI), so refuse instead.
+            if any(
+                getattr(d, "platform", None) != "cpu" for d in devices
+            ):
+                raise RuntimeError(
+                    "hybrid mesh construction failed on non-CPU devices "
+                    f"(dcn_dp={config.dcn_dp}): an enumeration-order "
+                    "reshape would mis-route hierarchical collectives "
+                    "across the ICI/DCN boundary — fix the slice metadata "
+                    "or set mesh.dcn_dp=1"
+                ) from e
             _warn_topology_fallback(e)
             arr = np.asarray(devices).reshape(shape)
     else:

@@ -443,6 +443,8 @@ class Trainer:
         precision: str | Policy = "fp32",
         health: Any = None,
         fault_nan_step: int | None = None,
+        dcn_dp: int = 1,
+        comm_hierarchy: str = "auto",
     ):
         self.model = model
         # On-device health guard (health.py): a config.HealthConfig with
@@ -520,14 +522,45 @@ class Trainer:
             )
         self.update_sharding = update_sharding
         self.grad_bucket_mb = float(grad_bucket_mb)
+        # Hierarchical ICI+DCN gradient sync (comms_hier.py;
+        # docs/MULTISLICE.md): when the dp axis spans dcn_dp slices,
+        # decompose each bucket's collective into intra-slice reduce-scatter
+        # -> cross-slice all-reduce of the 1/ici shard (the only DCN
+        # traffic) -> intra-slice all-gather. Routed through
+        # _overlapped_dp_step_fn — a hierarchy is a per-bucket collective
+        # choice — so the same pure-DP fences below apply to it.
+        from .comms_hier import (
+            HierTopology,
+            check_comm_hierarchy_config,
+            resolve_hierarchy,
+        )
+
+        check_comm_hierarchy_config(
+            comm_hierarchy=comm_hierarchy, dcn_dp=dcn_dp,
+            dp=mesh.shape["dp"],
+        )
+        self.comm_hierarchy = comm_hierarchy
+        self.dcn_dp = dcn_dp
+        self._hier_topo = (
+            HierTopology(n=mesh.shape["dp"], dcn=dcn_dp)
+            if resolve_hierarchy(comm_hierarchy, dcn_dp)
+            else None
+        )
         self._overlap = (
-            self.grad_bucket_mb > 0 or update_sharding == "sharded"
+            self.grad_bucket_mb > 0
+            or update_sharding == "sharded"
+            or self._hier_topo is not None
         )
         if self._overlap:
             knobs = (
                 f"grad_bucket_mb={grad_bucket_mb}"
                 if self.grad_bucket_mb > 0
-                else f"update_sharding={update_sharding!r}"
+                else (
+                    f"update_sharding={update_sharding!r}"
+                    if update_sharding == "sharded"
+                    else f"comm_hierarchy={comm_hierarchy!r} "
+                    f"(dcn_dp={dcn_dp})"
+                )
             )
             if hasattr(model, "num_stages"):
                 raise NotImplementedError(
@@ -1139,6 +1172,14 @@ class Trainer:
         reduce-scatter + all-gather over 'dp' and NO full-gradient
         all-reduce.
 
+        ``comm_hierarchy`` (comms_hier.py; docs/MULTISLICE.md): when a
+        hierarchy topology is active, every per-bucket collective above is
+        swapped for its two-level ICI+DCN decomposition — intra-slice
+        reduce-scatter, cross-slice all-reduce of the 1/ici shard (the only
+        DCN traffic), intra-slice all-gather — and under 'sharded' the
+        shard member i owns becomes GLOBAL chunk ``topo.chunk_index(i)``
+        for the life of the run.
+
         Returns the same ``(state, batch) -> (state, metrics)`` body as
         every other step fn, so the health-guard wrap and the fused K-step
         scan compose unchanged.
@@ -1153,6 +1194,57 @@ class Trainer:
         n = self.mesh.shape["dp"]
         lossy = mode != "fp32"
         layout = self._bucket_layout_for(self.abstract_state.params)
+        # Collective routing: flat (comms_overlap) vs hierarchical
+        # (comms_hier) — same per-bucket call shape, so both update
+        # variants below are hierarchy-agnostic. Under the hierarchy,
+        # member i's reduce-scatter output is GLOBAL chunk
+        # topo.chunk_index(i), so the shard index fed to
+        # layout.local_shards must follow (docs/MULTISLICE.md).
+        topo = self._hier_topo
+        if topo is not None:
+            from . import comms_hier
+
+            def _all_reduce_buckets(grads, res):
+                return comms_hier.bucketed_hier_all_reduce(
+                    grads, layout, "dp", topo,
+                    mode=mode, block_size=block, residuals=res,
+                )
+
+            def _reduce_scatter_buckets(grads, res):
+                return comms_hier.bucketed_hier_reduce_scatter(
+                    grads, layout, "dp", topo,
+                    mode=mode, block_size=block, residuals=res,
+                )
+
+            def _gather_param_buckets(shards):
+                return comms_hier.hier_all_gather_buckets(
+                    shards, layout, "dp", topo
+                )
+
+            def _shard_index(i):
+                return topo.chunk_index(i)
+        else:
+
+            def _all_reduce_buckets(grads, res):
+                return comms_overlap.bucketed_all_reduce(
+                    grads, layout, "dp",
+                    mode=mode, block_size=block, residuals=res,
+                )
+
+            def _reduce_scatter_buckets(grads, res):
+                return comms_overlap.bucketed_reduce_scatter(
+                    grads, layout, "dp",
+                    mode=mode, block_size=block, residuals=res,
+                )
+
+            def _gather_param_buckets(shards):
+                return comms_overlap.all_gather_buckets(
+                    shards, layout, "dp"
+                )
+
+            def _shard_index(i):
+                return i
+
         param_specs = jax.tree.map(
             lambda s: s.spec, self.state_shardings.params
         )
@@ -1186,10 +1278,7 @@ class Trainer:
                     params, model_state, batch, rng
                 )
                 res = [r[0] for r in residual] if lossy else None
-                summed, new_res = comms_overlap.bucketed_all_reduce(
-                    grads, layout, "dp",
-                    mode=mode, block_size=block, residuals=res,
-                )
+                summed, new_res = _all_reduce_buckets(grads, res)
                 grads = jax.tree.map(lambda g: g / n, summed)
                 new_res = tuple(r[None] for r in new_res) if lossy else ()
                 return grads, metrics, updates, new_res
@@ -1243,10 +1332,7 @@ class Trainer:
                 params, model_state, batch, rng
             )
             res = [r[0] for r in residual] if lossy else None
-            shard_grads, new_res = comms_overlap.bucketed_reduce_scatter(
-                grads, layout, "dp",
-                mode=mode, block_size=block, residuals=res,
-            )
+            shard_grads, new_res = _reduce_scatter_buckets(grads, res)
             shard_grads = tuple(g / n for g in shard_grads)
             # _instrument_grads, shard-view edition: poison first, then the
             # norm, so the guard detects exactly what the optimizer eats.
@@ -1264,7 +1350,7 @@ class Trainer:
                     **metrics,
                     "grad_norm": jnp.sqrt(jax.lax.psum(sq, "dp")),
                 }
-            i = jax.lax.axis_index("dp")
+            i = _shard_index(jax.lax.axis_index("dp"))
             param_shards = layout.local_shards(params, i)
             opt_local = jax.tree.map(
                 lambda x: x[0] if x.ndim == 2 else x, opt_state
@@ -1273,9 +1359,7 @@ class Trainer:
                 shard_grads, opt_local, param_shards
             )
             new_shards = optax.apply_updates(param_shards, upd)
-            new_params = comms_overlap.all_gather_buckets(
-                new_shards, layout, "dp"
-            )
+            new_params = _gather_param_buckets(new_shards)
             new_opt = jax.tree.map(
                 lambda x: x[None] if x.ndim == 1 else x, new_opt
             )

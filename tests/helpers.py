@@ -154,11 +154,18 @@ def compiled_step_text(trainer, example_batch, mesh, *, spmd: bool = False):
     # The persistent compile cache (conftest) would satisfy this compile
     # without running any pass — and an executable fetched from cache dumps
     # nothing. Dump options are scrubbed from the cache key, so a prior
-    # plain compile of the same program (e.g. the golden-identity test)
-    # silently starves the dump; disable the cache for this one compile.
+    # plain compile of the same program — even from an EARLIER pytest run,
+    # the cache dir is cross-process — silently starves the dump; disable
+    # the cache for this one compile. Flipping the config flag alone is not
+    # enough: jax initializes its cache object exactly once per process and
+    # keeps serving it afterwards, so drop that object too (reset_cache)
+    # and let it lazily re-initialize as disabled / re-enabled.
+    from jax._src import compilation_cache as _cc
+
     cache_dir = jax.config.jax_compilation_cache_dir
     try:
         jax.config.update("jax_compilation_cache_dir", None)
+        _cc.reset_cache()
         lowered.compile(
             {"xla_dump_to": dump, "xla_dump_hlo_pass_re": "spmd"}
         )
@@ -170,6 +177,7 @@ def compiled_step_text(trainer, example_batch, mesh, *, spmd: bool = False):
             return f.read()
     finally:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _cc.reset_cache()
         shutil.rmtree(dump, ignore_errors=True)
 
 
@@ -197,6 +205,37 @@ def dp_group_payloads(text: str, n: int, kind: str) -> list[int]:
     from distributeddeeplearning_tpu.utils.hlo import collective_bytes
 
     return sorted(p for p, g in collective_bytes(text, n).get(kind, ()) if g == n)
+
+
+def group_payloads(text: str, n: int, kind: str, group: int) -> list[int]:
+    """Sorted payload bytes of every ``kind`` collective whose replica
+    groups have exactly ``group`` members — the hierarchy tests' view of
+    sub-axis collectives (``group == n`` reproduces dp_group_payloads)."""
+    from distributeddeeplearning_tpu.utils.hlo import collective_bytes
+
+    return sorted(
+        p for p, g in collective_bytes(text, n).get(kind, ()) if g == group
+    )
+
+
+def replica_group_sets(text: str, kind: str) -> list[frozenset[frozenset[int]]]:
+    """The explicit replica-group partition of every ``kind`` collective in
+    HLO text, as a set of member sets — what the hierarchy HLO tests pin:
+    intra-slice groups ``{{0..ici-1}, ...}`` vs cross-slice groups
+    ``{{0, ici, ...}, ...}`` (docs/MULTISLICE.md)."""
+    out = []
+    pat = re.compile(
+        rf"{kind}(?:-start)?\(.*replica_groups=\{{(\{{[0-9,]+\}}"
+        rf"(?:,\{{[0-9,]+\}})*)\}}"
+    )
+    for line in text.splitlines():
+        m = pat.search(line)
+        if m:
+            out.append(frozenset(
+                frozenset(int(x) for x in grp.split(","))
+                for grp in re.findall(r"\{([0-9,]+)\}", m.group(1))
+            ))
+    return out
 
 
 def entry_schedule(text: str, *, min_payload: int) -> tuple[list[int], list[int]]:

@@ -1,0 +1,100 @@
+"""Schema pin for the benchmark-trajectory report.
+
+``tools/bench_report.py`` folds every ``BENCH_*.json`` at the repo root
+into one BENCH_TRAJECTORY.json index. The schema is version-pinned here
+so downstream readers (and the committed artifact) can rely on it; the
+tool's honesty properties — unknown shapes indexed without fabricated
+headlines, unreadable files named not dropped — are asserted on a
+synthetic corpus.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_REPO, "tools", "bench_report.py")
+_ARTIFACT = os.path.join(_REPO, "BENCH_TRAJECTORY.json")
+
+
+def _run_report(src_dir):
+    env = dict(os.environ)
+    env.update(DDL_REPORT_DIR=str(src_dir))
+    proc = subprocess.run(
+        [sys.executable, _TOOL], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(os.path.join(str(src_dir), "BENCH_TRAJECTORY.json")) as f:
+        return json.load(f)
+
+
+def _check_schema(rec):
+    assert rec["schema_version"] == 1
+    assert rec["source_glob"] == "BENCH_*.json"
+    assert isinstance(rec["artifacts"], dict)
+    assert isinstance(rec["unreadable"], dict)
+    for name, entry in rec["artifacts"].items():
+        assert name.startswith("BENCH_") and name.endswith(".json")
+        assert set(entry) == {"utc", "keys", "headline"}
+        assert isinstance(entry["keys"], list)
+        assert isinstance(entry["headline"], dict)
+
+
+def test_report_on_synthetic_corpus(tmp_path):
+    (tmp_path / "BENCH_A.json").write_text(json.dumps(
+        {"utc": "2026-01-01T00:00:00Z", "steps_per_sec": 12.5,
+         "rows": {"x": 1, "y": 2}}
+    ))
+    # Unknown shape: indexed, headline honestly empty except numerics.
+    (tmp_path / "BENCH_B.json").write_text(json.dumps(
+        {"weird_metric": 3.5, "_private": 9}
+    ))
+    (tmp_path / "BENCH_BAD.json").write_text("{not json")
+    rec = _run_report(tmp_path)
+    _check_schema(rec)
+    assert set(rec["artifacts"]) == {"BENCH_A.json", "BENCH_B.json"}
+    a = rec["artifacts"]["BENCH_A.json"]
+    assert a["headline"]["steps_per_sec"] == 12.5
+    assert a["headline"]["n_rows"] == 2
+    b = rec["artifacts"]["BENCH_B.json"]
+    assert b["headline"] == {"weird_metric": 3.5}  # _private excluded
+    assert "BENCH_BAD.json" in rec["unreadable"]
+    # The report indexes itself out: re-running must be stable.
+    rec2 = _run_report(tmp_path)
+    assert "BENCH_TRAJECTORY.json" not in rec2["artifacts"]
+    assert set(rec2["artifacts"]) == set(rec["artifacts"])
+
+
+def test_report_on_repo_root(tmp_path):
+    # Against the real committed corpus (written to a scratch path so the
+    # committed BENCH_TRAJECTORY.json is not touched by the test).
+    env = dict(os.environ)
+    env.update(DDL_REPORT_OUT=str(tmp_path / "BENCH_TRAJECTORY.json"))
+    proc = subprocess.run(
+        [sys.executable, _TOOL], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads((tmp_path / "BENCH_TRAJECTORY.json").read_text())
+    _check_schema(rec)
+    # The round-harness dumps and the subsystem benches are all indexed.
+    assert "BENCH_OVERLAP.json" in rec["artifacts"]
+    overlap = rec["artifacts"]["BENCH_OVERLAP.json"]["headline"]
+    assert 0.0 <= overlap["measured_overlap_fraction"] <= 1.0
+    if "BENCH_MULTISLICE.json" in rec["artifacts"]:
+        ms = rec["artifacts"]["BENCH_MULTISLICE.json"]["headline"]
+        assert ms["max_dcn_byte_reduction"] > 2.0
+        assert "effective_dcn_bytes_per_sec" in ms  # null-or-number, named
+
+
+def test_committed_trajectory_artifact():
+    if not os.path.exists(_ARTIFACT):
+        pytest.skip("BENCH_TRAJECTORY.json not yet generated")
+    with open(_ARTIFACT) as f:
+        rec = json.load(f)
+    _check_schema(rec)
+    assert "BENCH_MULTISLICE.json" in rec["artifacts"]

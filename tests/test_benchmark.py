@@ -68,6 +68,58 @@ def test_run_benchmark_zero_warmup_is_legal():
         assert key not in record
 
 
+def test_run_benchmark_hierarchy_telemetry():
+    # Multi-slice telemetry (docs/MULTISLICE.md): the resolved hierarchy,
+    # dcn_dp, per-phase wire bytes, and dcn_wire_bytes — the cross-slice
+    # all-reduce of the 1/ici shard is the ONLY DCN traffic under the
+    # hierarchical path.
+    from dataclasses import replace
+
+    cfg = _tiny_cfg()
+    cfg = replace(
+        cfg,
+        mesh=MeshConfig(dp=8, dcn_dp=2),
+        train=replace(cfg.train, comm_hierarchy="auto"),
+    )
+    record = run_benchmark(cfg, warmup=0, steps=2, latency_steps=0,
+                           fused_probe=0)
+    assert record["comm_hierarchy"] == "hierarchical"
+    assert record["dcn_dp"] == 2
+    phases = record["hier_phase_wire_bytes"]
+    total = sum(record["grad_bucket_wire_bytes"])
+    ici = 4
+    assert phases["intra_reduce_scatter_bytes"] == int(total * (ici - 1) / ici)
+    assert phases["cross_all_reduce_bytes"] == int(total / ici * 2 * (2 - 1) / 2)
+    assert record["dcn_wire_bytes"] == phases["cross_all_reduce_bytes"]
+    # The hierarchy's whole point, in bytes: DCN traffic shrinks ~ici-fold
+    # vs the flat ring on the same hybrid mesh.
+    assert record["dcn_wire_bytes"] < record["grad_sync_bytes_per_step"] / 2
+    json.dumps(record)
+
+
+def test_run_benchmark_flat_dcn_telemetry():
+    # Flat sync on a hybrid mesh: the ring spans slices, so the FULL sync
+    # traffic rides DCN; on a single slice there is no DCN at all.
+    from dataclasses import replace
+
+    cfg = _tiny_cfg()
+    flat_hybrid = replace(
+        cfg,
+        mesh=MeshConfig(dp=8, dcn_dp=2),
+        train=replace(cfg.train, comm_hierarchy="flat"),
+    )
+    record = run_benchmark(flat_hybrid, warmup=0, steps=2, latency_steps=0,
+                           fused_probe=0)
+    assert record["comm_hierarchy"] == "flat"
+    assert record["dcn_wire_bytes"] == record["grad_sync_bytes_per_step"] > 0
+    assert "hier_phase_wire_bytes" not in record
+
+    single = run_benchmark(_tiny_cfg(), warmup=0, steps=2, latency_steps=0,
+                           fused_probe=0)
+    assert single["dcn_dp"] == 1
+    assert single["dcn_wire_bytes"] == 0
+
+
 def test_run_benchmark_fused_probe_fields():
     # The fused-dispatch probe quantifies what steps_per_call amortizes:
     # an unfused-minus-fused per-step delta (signed — fusion may LOSE).

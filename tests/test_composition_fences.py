@@ -371,3 +371,136 @@ def test_overlap_composes_with_health_guard():
         mesh_of(dp=8), update_sharding="sharded",
         health=HealthConfig(enabled=True),
     )
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical ICI+DCN gradient sync (train.comm_hierarchy, mesh.dcn_dp)
+# x everything else
+#
+# The matrix docs/MULTISLICE.md promises: the hierarchy rides the overlapped
+# step path, so it inherits the pure-DP fences above; its own fences are
+# topology-shaped (mode names, dcn_dp divisibility, degenerate slices).
+# Legal pairs build here; their numerics are pinned in test_hier.py.
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchy_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="comm_hierarchy"):
+        _overlap_trainer(mesh_of(dp=8), dcn_dp=2, comm_hierarchy="fastest")
+
+
+def test_hierarchy_rejects_forced_on_single_slice():
+    # comm_hierarchy='hierarchical' with dcn_dp=1 has no cross-slice axis to
+    # decompose over — a silent flat fallback would misreport the telemetry.
+    with pytest.raises(ValueError, match="dcn_dp"):
+        _overlap_trainer(mesh_of(dp=8), dcn_dp=1, comm_hierarchy="hierarchical")
+
+
+def test_hierarchy_rejects_indivisible_and_degenerate_topology():
+    from distributeddeeplearning_tpu.comms_hier import (
+        check_comm_hierarchy_config,
+    )
+
+    # dp=8 over dcn_dp=3 slices: no even split.
+    with pytest.raises(ValueError, match="divisible"):
+        check_comm_hierarchy_config(
+            comm_hierarchy="hierarchical", dcn_dp=3, dp=8
+        )
+    # dp == dcn_dp: every "slice" is one member — ici degenerates to 1 and
+    # the intra phases are no-ops; flat IS the hierarchy, so refuse.
+    with pytest.raises(ValueError, match="ici"):
+        check_comm_hierarchy_config(
+            comm_hierarchy="hierarchical", dcn_dp=8, dp=8
+        )
+
+
+def test_hierarchy_inherits_pure_dp_fences():
+    # Hierarchy routes through the overlapped step path, so busy model axes
+    # and grad_accum must fail by name exactly like grad_bucket_mb does.
+    with pytest.raises(NotImplementedError, match="pure-DP"):
+        _overlap_trainer(
+            mesh_of(dp=4, fsdp=2), dcn_dp=2, comm_hierarchy="hierarchical"
+        )
+    with pytest.raises(NotImplementedError, match="comm_hierarchy.*grad_accum"):
+        _overlap_trainer(
+            mesh_of(dp=8), dcn_dp=2, comm_hierarchy="hierarchical",
+            grad_accum=2,
+        )
+
+
+@pytest.mark.parametrize(
+    "trainer_kw",
+    [
+        dict(comm_hierarchy="hierarchical"),
+        dict(comm_hierarchy="auto"),
+        dict(comm_hierarchy="flat"),
+        dict(comm_hierarchy="auto", grad_bucket_mb=0.5),
+        dict(comm_hierarchy="auto", update_sharding="sharded"),
+        dict(comm_hierarchy="auto", grad_comm="int8"),
+        dict(comm_hierarchy="auto", zero1=True),
+    ],
+    ids=["forced", "auto", "flat-on-hybrid", "bucketed", "sharded", "int8",
+         "zero1"],
+)
+def test_hierarchy_legal_pairs_build(trainer_kw):
+    _overlap_trainer(mesh_of(dp=8), dcn_dp=2, **trainer_kw)
+
+
+def test_hierarchy_composes_with_precision_and_health():
+    from distributeddeeplearning_tpu.config import HealthConfig
+
+    _precision_trainer(
+        _bf16_model(), mesh_of(dp=8), dcn_dp=2, comm_hierarchy="auto"
+    )
+    _overlap_trainer(
+        mesh_of(dp=8), dcn_dp=2, comm_hierarchy="auto",
+        health=HealthConfig(enabled=True),
+    )
+
+
+def test_cli_threads_hierarchy_knobs():
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import (
+        Config, DataConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig,
+    )
+
+    cfg = Config(
+        model=ModelConfig(
+            name="gpt2",
+            kwargs=dict(size="tiny", vocab_size=128, max_len=32,
+                        dropout_rate=0.0),
+        ),
+        data=DataConfig(kind="synthetic_tokens", batch_size=8, seq_len=16,
+                        vocab_size=128),
+        optim=OptimConfig(name="adamw", lr=1e-3),
+        train=TrainConfig(steps=1, task="lm", comm_hierarchy="auto"),
+        mesh=MeshConfig(dp=8, dcn_dp=2),
+    )
+    _, _, trainer, _ = build_all(cfg)
+    assert trainer.comm_hierarchy == "auto"
+    assert trainer.dcn_dp == 2
+    assert trainer._hier_topo is not None
+    assert trainer._hier_topo.ici == 4
+
+
+def test_cli_fences_hierarchy_before_mesh_build():
+    # The mode-name fence must fire in build_all even when the mesh itself
+    # would be buildable — by name, before any device work.
+    from distributeddeeplearning_tpu.cli import build_all
+    from distributeddeeplearning_tpu.config import (
+        Config, DataConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig,
+    )
+
+    cfg = Config(
+        model=ModelConfig(
+            name="gpt2",
+            kwargs=dict(size="tiny", vocab_size=128, max_len=32),
+        ),
+        data=DataConfig(kind="synthetic_tokens", batch_size=8, seq_len=16,
+                        vocab_size=128),
+        optim=OptimConfig(name="adamw", lr=1e-3),
+        train=TrainConfig(steps=1, task="lm", comm_hierarchy="hierarchical"),
+        mesh=MeshConfig(dp=8, dcn_dp=1),
+    )
+    with pytest.raises(ValueError, match="comm_hierarchy"):
+        build_all(cfg)

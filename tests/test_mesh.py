@@ -55,6 +55,30 @@ def test_hybrid_dcn_mesh_shape():
     assert mesh.shape["dp"] == 4
 
 
+def test_hybrid_fallback_cpu_sim_is_enumeration_order():
+    # On the CPU sim create_hybrid_device_mesh has no slice metadata, so
+    # build_mesh falls back to the enumeration-order reshape: dcn_dp groups
+    # consecutive devices into slices — the member-numbering contract
+    # comms_hier.HierTopology builds its replica groups on.
+    mesh = build_mesh(MeshConfig(dp=8, dcn_dp=2))
+    flat = list(mesh.devices.flatten())
+    assert flat == list(jax.devices())
+
+
+def test_hybrid_fallback_raises_on_non_cpu_devices():
+    # On real hardware the same fallback would silently route intra-slice
+    # collectives over DCN — build_mesh must refuse, not warn-and-reshape.
+    class FakeTpu:
+        platform = "tpu"
+
+        def __init__(self, i):
+            self.id = i
+
+    devices = [FakeTpu(i) for i in range(8)]
+    with pytest.raises(RuntimeError, match="mis-route"):
+        build_mesh(MeshConfig(dp=8, dcn_dp=2), devices=devices)
+
+
 def test_single_device_mesh():
     mesh = single_device_mesh()
     assert mesh.devices.size == 1

@@ -147,6 +147,27 @@ def test_measured_overlap_feeds_projection(shrunk):
                 <= proj["scaling_efficiency_full_overlap"])
 
 
+def test_measured_dcn_calibration_feeds_projection(shrunk):
+    # The DCN calibration (BENCH_MULTISLICE.json, docs/MULTISLICE.md):
+    # either a measured effective rate with provenance or a named reason
+    # (the CPU sim can't measure DCN), never silence — and every DCN
+    # projection with a measured compute base carries a measured-DCN
+    # efficiency bracketed by the serial / full-overlap bounds.
+    md = shrunk["measured_dcn"]
+    if md["effective_gbytes_per_sec"] is None:
+        assert md["reason"]
+    else:
+        assert md["effective_gbytes_per_sec"] > 0
+        assert "BENCH_MULTISLICE.json" in md["source"]
+    rn = shrunk["scenarios"][0]  # resnet50 has the silicon compute base
+    ici_proj, dcn_proj = rn["projections"]
+    assert "scaling_efficiency_measured_dcn" not in ici_proj  # DCN-only
+    assert dcn_proj["comm_ms_per_step_measured_dcn"] > 0
+    assert (dcn_proj["scaling_efficiency_no_overlap"]
+            <= dcn_proj["scaling_efficiency_measured_dcn"]
+            <= dcn_proj["scaling_efficiency_full_overlap"])
+
+
 def test_committed_artifact_is_full_size():
     if not os.path.exists(_ARTIFACT):
         pytest.skip("PROJECTED_SCALING.json not yet generated")

@@ -1,0 +1,121 @@
+"""Benchmark-trajectory report: BENCH_*.json -> BENCH_TRAJECTORY.json.
+
+The repo accumulates one benchmark artifact per subsystem (overlap,
+mixed precision, fused dispatch, serving, multislice, the per-round
+harness dumps) — each with its own shape, each read in isolation. This
+tool folds them into ONE index so a reader (or the next session) can see
+the whole measured trajectory at a glance: which artifacts exist, when
+they were generated, and their headline numbers.
+
+The report NEVER re-measures anything and never fails an artifact it
+doesn't recognize: unknown shapes still get indexed with their
+timestamp and top-level keys (``headline`` is then empty, not fabricated)
+— absence of a number is visible, not papered over. Unreadable files are
+listed under ``unreadable`` with the error.
+
+Schema (pinned by tests/test_bench_report.py):
+
+    {"schema_version": 1, "generated_utc": ..., "source_glob": ...,
+     "artifacts": {"<filename>": {"utc": ..., "keys": [...],
+                                  "headline": {...}}},
+     "unreadable": {"<filename>": "<error>"}}
+
+Usage: python tools/bench_report.py   (scans the repo root, or
+$DDL_REPORT_DIR; writes BENCH_TRAJECTORY.json there, or
+$DDL_REPORT_OUT).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DIR = os.environ.get("DDL_REPORT_DIR", _REPO)
+_OUT = os.environ.get(
+    "DDL_REPORT_OUT", os.path.join(_DIR, "BENCH_TRAJECTORY.json")
+)
+
+# Scalar top-level keys that count as headline numbers wherever they
+# appear (the per-subsystem artifacts share these by convention).
+_HEADLINE_KEYS = (
+    "value", "unit", "steps_per_sec", "speedup",
+    "measured_overlap_fraction",
+    "state_bytes_reduction_vs_fp32", "grad_sync_reduction_vs_fp32",
+    "dispatch_overhead_ms_per_step", "unfused_steps_per_sec",
+    "fused_steps_per_sec", "rc", "ok", "n", "n_devices", "shrunk",
+)
+
+
+def _headline(rec: dict) -> dict:
+    out: dict = {}
+    for k in _HEADLINE_KEYS:
+        if k in rec and isinstance(rec[k], (int, float, str, bool,
+                                            type(None))):
+            out[k] = rec[k]
+    if isinstance(rec.get("rows"), (dict, list)):
+        out["n_rows"] = len(rec["rows"])
+    # Multislice: the two numbers the subsystem exists for.
+    cal = rec.get("dcn_calibration")
+    if isinstance(cal, dict):
+        out["effective_dcn_bytes_per_sec"] = cal.get(
+            "effective_dcn_bytes_per_sec"
+        )
+    comps = rec.get("comparisons")
+    if isinstance(comps, dict):
+        reductions = [c["dcn_byte_reduction"] for c in comps.values()
+                      if isinstance(c, dict) and "dcn_byte_reduction" in c]
+        if reductions:
+            out["max_dcn_byte_reduction"] = max(reductions)
+    # BENCH_BASELINE-style flat metric tables: numeric leaves ARE the
+    # headline.
+    if not out:
+        for k, v in rec.items():
+            if not k.startswith("_") and isinstance(v, (int, float)):
+                out[k] = v
+    return out
+
+
+def main() -> int:
+    artifacts: dict = {}
+    unreadable: dict = {}
+    for path in sorted(glob.glob(os.path.join(_DIR, "BENCH_*.json"))):
+        name = os.path.basename(path)
+        if name == os.path.basename(_OUT):
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            unreadable[name] = f"{type(e).__name__}: {e}"
+            continue
+        if not isinstance(rec, dict):
+            unreadable[name] = f"top-level {type(rec).__name__}, not object"
+            continue
+        artifacts[name] = {
+            "utc": rec.get("utc"),
+            "keys": sorted(rec)[:24],
+            "headline": _headline(rec),
+        }
+    report = {
+        "schema_version": 1,
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "source_glob": "BENCH_*.json",
+        "artifacts": artifacts,
+        "unreadable": unreadable,
+    }
+    tmp = _OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, _OUT)
+    print(f"wrote {_OUT} ({len(artifacts)} artifacts indexed, "
+          f"{len(unreadable)} unreadable)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

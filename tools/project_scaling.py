@@ -29,6 +29,14 @@ Method, in full (the artifact repeats it so the table is auditable):
    and, for cross-slice (DCN) scenarios, the standard hierarchical
    decomposition: intra-slice phase over ICI on the full payload, then
    cross-slice phase over DCN on payload/ici_size.
+
+   DCN scenarios additionally carry a MEASURED-DCN column: when
+   BENCH_MULTISLICE.json (``tools/bench_multislice.py``; the
+   hierarchical-collective subsystem, docs/MULTISLICE.md) records a
+   measured effective DCN byte rate — derivable only on a real
+   multi-slice pod, null-with-reason on the CPU sim — that rate
+   replaces the assumed ``DDL_DCN_GBPS``; the column is clamped into
+   the [no_overlap, full_overlap] bracket the bounds define.
 4. t_compute_1chip comes from the MEASURED single-chip record
    (``BENCH_BASELINE.json`` / ``TPU_NUMBERS.json``); scenarios without a
    silicon measurement get comm-time columns only, with
@@ -126,8 +134,15 @@ def _wire_bytes(sync: dict, n: int) -> float:
     )
 
 
-def _comm_seconds(sync: dict, ici: int, n_slices: int) -> float:
-    """Hierarchical ring model over the per-kind gradient-sync payloads."""
+def _comm_seconds(
+    sync: dict, ici: int, n_slices: int, dcn_gbps: float | None = None
+) -> float:
+    """Hierarchical ring model over the per-kind gradient-sync payloads.
+
+    ``dcn_gbps`` overrides the assumed DCN bandwidth — the measured-DCN
+    projections pass the BENCH_MULTISLICE.json calibration rate here."""
+    if dcn_gbps is None:
+        dcn_gbps = DCN_GBPS
     t = 0.0
     for kind, payload in sync.items():
         if not payload:
@@ -137,7 +152,7 @@ def _comm_seconds(sync: dict, ici: int, n_slices: int) -> float:
         if n_slices > 1:
             # Cross-slice phase on the slice-sharded payload over DCN.
             t += _ring_factor(kind, n_slices) * (payload / ici) / (
-                DCN_GBPS * 1e9
+                dcn_gbps * 1e9
             )
     return t
 
@@ -225,6 +240,40 @@ def _measured_overlap():
         return None, "no measured_overlap_fraction in BENCH_OVERLAP.json"
     return float(frac), (
         f"BENCH_OVERLAP.json: {rec.get('measured_overlap_provenance', '?')} "
+        f"@ {rec.get('utc', '?')}"
+    )
+
+
+def _measured_dcn():
+    """(effective DCN GB/s, provenance) from BENCH_MULTISLICE.json, or
+    (None, reason). The calibration cell is the canonical fp32/dcn2 pair
+    of the multislice bench grid (tools/bench_multislice.py): the rate is
+    measurable only where flat-vs-hierarchical step times actually
+    diverge — a real multi-slice pod — and the bench records
+    null-with-reason on the CPU sim rather than a fabricated constant."""
+    path = os.environ.get(
+        "DDL_MULTISLICE_ARTIFACT",
+        os.path.join(_REPO, "BENCH_MULTISLICE.json"),
+    )
+    if not os.path.exists(path):
+        return None, (
+            "BENCH_MULTISLICE.json not generated (tools/bench_multislice.py)"
+        )
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        return None, f"BENCH_MULTISLICE.json unreadable: {e}"
+    cal = rec.get("dcn_calibration")
+    if not isinstance(cal, dict):
+        return None, "no dcn_calibration block in BENCH_MULTISLICE.json"
+    rate = cal.get("effective_dcn_bytes_per_sec")
+    if not isinstance(rate, (int, float)) or rate <= 0:
+        return None, cal.get(
+            "reason", "no measured effective DCN rate in calibration cell"
+        )
+    return float(rate) / 1e9, (
+        f"BENCH_MULTISLICE.json: {cal.get('cell', '?')} "
         f"@ {rec.get('utc', '?')}"
     )
 
@@ -318,6 +367,7 @@ def main() -> int:
 
     n_dev = jax.device_count()
     f_overlap, overlap_prov = _measured_overlap()
+    dcn_gbps_meas, dcn_prov = _measured_dcn()
     rows = []
     for name, key, overrides in SCENARIOS:
         if _SHRINK:
@@ -354,6 +404,28 @@ def main() -> int:
                 if f_overlap is not None:
                     proj["scaling_efficiency_measured_overlap"] = round(
                         t_compute / (t_compute + (1.0 - f_overlap) * t_comm),
+                        4,
+                    )
+                if n_slices > 1:
+                    # Measured-DCN column: same hierarchical model, the
+                    # DCN leg priced at the calibrated rate (assumed rate
+                    # when the calibration is honest-null), overlap at
+                    # the measured fraction, clamped into the bracket the
+                    # two bounds define — hiding can't exceed full
+                    # overlap, nor can calibration fall below serial.
+                    t_comm_cal = _comm_seconds(
+                        model_sync, ici, n_slices, dcn_gbps=dcn_gbps_meas
+                    )
+                    proj["comm_ms_per_step_measured_dcn"] = round(
+                        t_comm_cal * 1e3, 3
+                    )
+                    raw = t_compute / (
+                        t_compute + (1.0 - (f_overlap or 0.0)) * t_comm_cal
+                    )
+                    proj["scaling_efficiency_measured_dcn"] = round(
+                        min(proj["scaling_efficiency_full_overlap"],
+                            max(proj["scaling_efficiency_no_overlap"],
+                                raw)),
                         4,
                     )
                 if name == "resnet50_imagenet":
@@ -437,6 +509,11 @@ def main() -> int:
             {"fraction": f_overlap, "source": overlap_prov}
             if f_overlap is not None
             else {"fraction": None, "reason": overlap_prov}
+        ),
+        "measured_dcn": (
+            {"effective_gbytes_per_sec": dcn_gbps_meas, "source": dcn_prov}
+            if dcn_gbps_meas is not None
+            else {"effective_gbytes_per_sec": None, "reason": dcn_prov}
         ),
         "shrunk": _SHRINK,
         "sim_devices": n_dev,
