@@ -353,7 +353,12 @@ def cmd_serve(cfg: Config, prompts: list[str], max_new_tokens: int,
         updates["mesh"] = None
     if updates:
         model = model.clone(**updates)
-    engine = ServingEngine(model, state.params, cfg.serving, seed=seed)
+    from .telemetry import Telemetry
+
+    tel = Telemetry.from_config(cfg)
+    engine = ServingEngine(
+        model, state.params, cfg.serving, seed=seed, telemetry=tel
+    )
     engine.warmup()
     for p in prompts:
         engine.submit(Request(
@@ -361,6 +366,7 @@ def cmd_serve(cfg: Config, prompts: list[str], max_new_tokens: int,
             temperature=temperature, top_k=top_k, top_p=top_p,
         ))
     finished = engine.run()
+    tel.write_trace()
     results = []
     for st in finished:
         m = st.metrics()
@@ -369,21 +375,30 @@ def cmd_serve(cfg: Config, prompts: list[str], max_new_tokens: int,
             t for t in st.generated if 0 <= t < 256
         ).decode("utf-8", errors="replace")
         results.append(m)
-    print(json.dumps({
+    record = {
         "step": int(state.step),
         "results": results,
         "stats": engine.stats(),
         "events": engine.events,
-    }))
+    }
+    if tel.enabled:
+        record["telemetry"] = tel.registry.to_dict()
+        record["telemetry_dir"] = tel.dir
+    print(json.dumps(record))
     return 0
 
 
-def _train_once(cfg: Config, fault) -> int:
+def _train_once(cfg: Config, fault, telemetry=None) -> int:
     """One training attempt: build, restore-or-init, fit. Raises
     ``train.Preempted`` / ``train.HealthRollback`` for ``cmd_train``'s outer
     policy loop — re-entry restores the latest durable checkpoint, which is
     the whole rollback mechanism (the data iterator cannot rewind, so
-    rollback == resume)."""
+    rollback == resume). ``telemetry`` (a ``telemetry.Telemetry``) brackets
+    the attempt: goodput ledger opened at the resume step / closed on every
+    exit path, trace written at the attempt boundary."""
+    from .telemetry import NULL_TELEMETRY
+
+    tel = telemetry if telemetry is not None else NULL_TELEMETRY
     mesh, _, trainer, dataset = build_all(
         cfg,
         fault_nan_step=(
@@ -425,6 +440,10 @@ def _train_once(cfg: Config, fault) -> int:
     batches = data_lib.prefetch(placed, size=cfg.data.prefetch_size)
     writer = MetricWriter(cfg.train.log_dir)
     profiler = Profiler(cfg.train.profile_steps, cfg.train.log_dir)
+    if tel.ledger is not None:
+        # Open AT the resume step: the ledger re-reads its sidecar here, so
+        # steps an earlier attempt already passed classify rollback_replay.
+        tel.ledger.open(start_index)
     try:
         fit(
             trainer,
@@ -442,6 +461,7 @@ def _train_once(cfg: Config, fault) -> int:
             eval_every=cfg.train.eval_every,
             eval_fn=make_eval_fn(cfg, mesh) if cfg.train.eval_every else None,
             health=cfg.health if cfg.health.enabled else None,
+            telemetry=tel,
         )
     finally:
         # Always drain the async checkpoint queue — an abandoned in-flight
@@ -450,6 +470,11 @@ def _train_once(cfg: Config, fault) -> int:
             ckpt.wait()
             ckpt.close()
         writer.close()
+        # Attempt boundary: ledger record appended, newest trace replaced —
+        # on EVERY exit path (clean, Preempted, HealthRollback unwind).
+        if tel.ledger is not None:
+            tel.ledger.close()
+        tel.write_trace()
     return 0
 
 
@@ -489,10 +514,19 @@ def cmd_train(cfg: Config) -> int:
     if cfg.train.debug_checks:
         jax.config.update("jax_enable_checks", True)
 
+    # One Telemetry bundle per process (NULL when disabled): the attempt
+    # stamp is the supervisor's, so a restarted child's ledger records and
+    # flight files are attributable; an in-process health rollback REUSES
+    # the bundle (same attempt, next ledger run, device registry kept so
+    # the re-entered fit doesn't re-lower the step).
+    from .telemetry import Telemetry
+
+    tel = Telemetry.from_config(cfg, attempt=attempt)
+
     rollbacks = 0
     while True:
         try:
-            return _train_once(cfg, fault)
+            return _train_once(cfg, fault, tel)
         except Preempted as p:
             # fit already force-saved synchronously; the exit code tells the
             # supervisor "done, do not restart".
@@ -529,6 +563,8 @@ def cmd_supervise(args) -> int:
     restart/backoff/hang knobs come from the config's ``supervisor`` section.
     The supervising process itself never touches the accelerator — it is a
     pure process babysitter, so it can outlive any child crash."""
+    import os
+
     from .supervisor import supervise_command
 
     cfg = apply_overrides(load_config(args.config), args.override)
@@ -543,7 +579,58 @@ def cmd_supervise(args) -> int:
     clear = ()
     if cfg.supervisor.clear_cache_on_crash and cfg.train.compile_cache_dir:
         clear = (cfg.train.compile_cache_dir,)
-    return supervise_command(cmd, cfg.supervisor, crash_clear_paths=clear)
+    # Telemetry seam: children write their attempt ledgers/flight records
+    # into the SAME dir (the overrides above carry telemetry.* through);
+    # the supervisor adds backoff records, hang/crash flight dumps, and
+    # the exit goodput_summary — without ever touching the accelerator
+    # (telemetry.py is stdlib-only).
+    goodput_path = flight_dir = None
+    if cfg.telemetry.enabled:
+        from .telemetry import resolve_dir
+
+        flight_dir = resolve_dir(cfg)
+        os.makedirs(flight_dir, exist_ok=True)
+        goodput_path = os.path.join(flight_dir, cfg.telemetry.goodput_file)
+    return supervise_command(
+        cmd, cfg.supervisor, crash_clear_paths=clear,
+        goodput_path=goodput_path, flight_dir=flight_dir,
+    )
+
+
+def cmd_report(tdir: str) -> int:
+    """Summarize a telemetry dir (``cli report --dir ...``): goodput
+    decomposition, trace validity/size, and the flight records present.
+    Pure stdlib — runs before ``init_distributed`` (no accelerator), so it
+    works on a quarantined artifact dir copied off the pod."""
+    import glob
+    import os
+
+    from .telemetry import summarize_goodput, validate_chrome_trace
+
+    out: dict = {"dir": tdir}
+    out["goodput"] = summarize_goodput(os.path.join(tdir, "goodput.jsonl"))
+    trace_path = os.path.join(tdir, "trace.json")
+    if os.path.exists(trace_path):
+        try:
+            with open(trace_path) as f:
+                trace = json.load(f)
+            problems = validate_chrome_trace(trace)
+        except (OSError, ValueError):
+            trace, problems = {}, ["unreadable trace.json"]
+        out["trace"] = {
+            "path": trace_path,
+            "events": len(trace.get("traceEvents", ())),
+            "valid": not problems,
+            "problems": problems,
+        }
+    else:
+        out["trace"] = None
+    out["flights"] = sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(tdir, "flight_*.json"))
+    )
+    print(json.dumps(out, indent=2))
+    return 0
 
 
 def _free_port() -> int:
@@ -668,6 +755,17 @@ def main(argv=None) -> int:
             help="apply mesh.XLA_PERF_FLAGS (async-collective overlap) "
             "before backend init",
         )
+        p.add_argument(
+            "--telemetry",
+            nargs="?",
+            const="",
+            default=None,
+            metavar="DIR",
+            help="enable unified telemetry (spans/goodput/flight recorder; "
+            "docs/OBSERVABILITY.md) — sugar for telemetry.* overrides; "
+            "optional DIR overrides the default quarantine-adjacent "
+            "<checkpoint_dir>/telemetry output dir",
+        )
         if name in ("generate", "serve"):
             p.add_argument(
                 "--prompt", required=True, action="append",
@@ -702,7 +800,23 @@ def main(argv=None) -> int:
                 help="jax.distributed coordinator port (0 = pick a free "
                 "one)",
             )
+    pr = sub.add_parser("report")
+    pr.add_argument(
+        "--dir", required=True,
+        help="telemetry output dir (the run's --telemetry DIR, or the "
+        "default <checkpoint_dir>/telemetry)",
+    )
     args = parser.parse_args(argv)
+    if args.cmd == "report":
+        # Pure artifact reader — no backend, no config, no rendezvous.
+        return cmd_report(args.dir)
+    if getattr(args, "telemetry", None) is not None:
+        # Desugar BEFORE the supervise/launch dispatch: both build their
+        # child command line from args.override, so children inherit the
+        # exact same telemetry config as the parent resolved.
+        args.override = list(args.override) + ["telemetry.enabled=True"]
+        if args.telemetry:
+            args.override.append(f"telemetry.dir={args.telemetry}")
     if args.cmd == "supervise":
         # BEFORE init_distributed: the supervisor must not claim the backend
         # or the coordinator port its children need.

@@ -290,6 +290,29 @@ class SupervisorConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Unified telemetry (``telemetry.py``; docs/OBSERVABILITY.md): span
+    tracing, the goodput ledger, the device memory/compile registry and
+    the crash flight recorder. Off by default — the instrumented paths
+    cost one truthiness check per hook when disabled (the ``--telemetry``
+    CLI flag flips ``enabled`` without a config edit)."""
+
+    enabled: bool = False
+    # Output dir for trace.json / spans.jsonl / goodput.jsonl / flight_*
+    # files. "" resolves quarantine-adjacent: <train.checkpoint_dir>/
+    # telemetry when a checkpoint dir is set, else a temp fallback
+    # (telemetry.resolve_dir).
+    dir: str = ""
+    # Completed spans kept in the bounded ring (memory cap; the Chrome
+    # trace exports whatever the ring holds — the most recent history).
+    ring_size: int = 4096
+    # Spans + events dumped per crash flight record.
+    flight_last: int = 256
+    trace_file: str = "trace.json"
+    goodput_file: str = "goodput.jsonl"
+
+
+@dataclasses.dataclass(frozen=True)
 class ServingConfig:
     """Serving engine (``serving/``; ``serve`` CLI subcommand): continuous
     batching over a paged KV cache with AOT prefill/decode programs. See
@@ -318,6 +341,9 @@ class ServingConfig:
     # Stop decoding a request when it emits this token (-1 = run to
     # max_new_tokens; byte-tokenizer CLI serving has no EOS).
     eos_id: int = -1
+    # Emit queue-depth / free-block gauges (metrics.serving_gauges) every
+    # this many engine steps through the engine's event stream. 0 = off.
+    gauge_every: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -332,6 +358,9 @@ class Config:
         default_factory=SupervisorConfig
     )
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, default=str)

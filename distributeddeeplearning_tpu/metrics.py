@@ -39,6 +39,20 @@ def serving_event(name: str, step: int, *, request_id: int, **fields) -> dict:
     return event_record(name, step, request_id=request_id, **fields)
 
 
+def serving_gauges(step: int, *, pending: int, active: int, free_blocks: int,
+                   used_blocks: int, **fields) -> dict:
+    """Engine-level GAUGES on the same record shape as lifecycle events
+    (one stream consumer handles both), emitted every
+    ``serving.gauge_every`` engine steps. Gauges describe the ENGINE, not
+    one request — no ``request_id``, hence not a :data:`SERVING_EVENTS`
+    member: queue depth and pool occupancy are what capacity tuning reads
+    (docs/OBSERVABILITY.md)."""
+    return event_record(
+        "serving_gauges", step, pending=int(pending), active=int(active),
+        free_blocks=int(free_blocks), used_blocks=int(used_blocks), **fields,
+    )
+
+
 class DeferredMetrics:
     """One-interval-lag metric fetch: the non-blocking logging path.
 
@@ -102,28 +116,68 @@ class DeferredMetrics:
 
 
 class MetricWriter:
-    """TensorBoard scalar writer (process 0 only); no-op without a logdir."""
+    """Scalar writer (process 0 only); no-op without a logdir.
+
+    Two sinks per ``write``: TensorBoard summaries via clu, and a
+    machine-readable ``<logdir>/metrics.jsonl`` — one ``{"schema": 1,
+    "step": N, ...scalars}`` line per logged interval (``schema`` is the
+    line-format version, bumped on any key-shape change so downstream
+    parsers can refuse rather than misread). ``close()`` guarantees the
+    JSONL sink is flushed and closed — a run killed right after close
+    loses no lines."""
 
     def __init__(self, logdir: str | None):
         self._writer = None
+        self._jsonl = None
         if logdir and jax.process_index() == 0:
+            import os
+
             from clu import metric_writers
 
             self._writer = metric_writers.create_default_writer(
                 logdir, asynchronous=True
             )
+            try:
+                os.makedirs(logdir, exist_ok=True)
+                self._jsonl = open(
+                    os.path.join(logdir, "metrics.jsonl"), "a"
+                )
+            except OSError:
+                self._jsonl = None  # disk trouble must not kill the run
 
     def write(self, step: int, scalars: dict[str, float]):
         if self._writer is not None:
             self._writer.write_scalars(step, scalars)
+        if self._jsonl is not None:
+            import json
+
+            try:
+                self._jsonl.write(
+                    json.dumps({"schema": 1, "step": int(step), **scalars})
+                    + "\n"
+                )
+            except (OSError, TypeError, ValueError):
+                pass
 
     def flush(self):
         if self._writer is not None:
             self._writer.flush()
+        if self._jsonl is not None:
+            try:
+                self._jsonl.flush()
+            except OSError:
+                pass
 
     def close(self):
         if self._writer is not None:
             self._writer.close()
+        if self._jsonl is not None:
+            try:
+                self._jsonl.flush()
+                self._jsonl.close()
+            except OSError:
+                pass
+            self._jsonl = None
 
 
 def parse_profile_window(spec: str) -> tuple[int, int] | None:

@@ -44,7 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..generate import _filter_logits, logits_at, prefill, decode_step
-from ..metrics import serving_event
+from ..metrics import serving_event, serving_gauges
+from ..telemetry import NULL_TELEMETRY
 from .quant import dequantize_params, quantization_error, quantize_params
 from .scheduler import KVBlockPool, Request, RequestState, Scheduler, blocks_for
 
@@ -124,7 +125,7 @@ class ServingEngine:
 
     def __init__(self, model, params, cfg, *, emit=None,
                  clock=time.monotonic, seed: int = 0,
-                 static_batching: bool = False):
+                 static_batching: bool = False, telemetry=None):
         if getattr(model, "attn_impl", "xla") != "xla":
             raise NotImplementedError(
                 f"serving x attn_impl={model.attn_impl!r} (see "
@@ -140,6 +141,11 @@ class ServingEngine:
         self.static_batching = static_batching
         self.events: list[dict] = []
         self._emit = emit if emit is not None else self.events.append
+        # Telemetry bundle (telemetry.py): schedule/prefill/decode spans,
+        # per-executable compile+memory records, and an event mirror for
+        # the flight recorder. NULL when the caller didn't wire one.
+        self._tel = telemetry if telemetry is not None else NULL_TELEMETRY
+        self.gauge_every = int(getattr(cfg, "gauge_every", 0))
         self.max_seq_len = int(cfg.max_seq_len) or int(model.max_len)
         if self.max_seq_len > int(model.max_len):
             raise ValueError(
@@ -281,9 +287,18 @@ class ServingEngine:
         tok, rng = self._sample_body(logits, rng, temp, tk, tp)
         return tok, rng, cache
 
-    def _compile(self, fn, *args):
+    def _compile(self, fn, *args, name: str | None = None):
         self.num_compiles += 1
-        return jax.jit(fn).lower(*args).compile()
+        t0 = time.perf_counter()
+        exe = jax.jit(fn).lower(*args).compile()
+        if name is not None:
+            # Device registry: compile wall time + memory_analysis(); a
+            # second record under one name shows up as recompiles > 0 —
+            # the zero-steady-state-recompile contract, visible as data.
+            self._tel.record_exe(
+                name, exe, compile_s=time.perf_counter() - t0
+            )
+        return exe
 
     def _prefill_exe_for(self, bucket: int):
         exe = self._prefill_exe.get(bucket)
@@ -298,6 +313,7 @@ class ServingEngine:
                 np.zeros((1, bucket), np.int32), np.zeros((1,), np.int32),
                 np.zeros((1, 2), np.uint32), np.zeros((1,), np.float32),
                 np.zeros((1,), np.int32), np.zeros((1,), np.float32),
+                name=f"serving_prefill_{bucket}",
             )
             self._prefill_exe[bucket] = exe
         return exe
@@ -311,6 +327,7 @@ class ServingEngine:
                 np.zeros((S, 1), np.int32), np.zeros((S, 2), np.uint32),
                 np.zeros((S,), np.float32), np.zeros((S,), np.int32),
                 np.zeros((S,), np.float32),
+                name="serving_decode",
             )
         return self._decode_exe
 
@@ -340,10 +357,12 @@ class ServingEngine:
         return self.scheduler.submit(request, self.clock())
 
     def _event(self, name: str, state: RequestState, **fields):
-        self._emit(serving_event(
+        rec = serving_event(
             name, self.step_count,
             request_id=state.request.request_id, **fields,
-        ))
+        )
+        self._emit(rec)
+        self._tel.note_event(rec)  # flight-recorder mirror
 
     def _finish_if_done(self, state: RequestState, tok: int) -> bool:
         req = state.request
@@ -411,26 +430,42 @@ class ServingEngine:
         """One engine iteration: admit (+prefill) into free lanes, then one
         decode call for the whole batch. Returns False when idle."""
         self.step_count += 1
+        tel = self._tel
         now = self.clock()
-        admitted = (
-            [] if self.static_batching and self.scheduler.active
-            else self.scheduler.admit(now, self.bucket_of)
-        )
+        with tel.span("schedule", step=self.step_count):
+            admitted = (
+                [] if self.static_batching and self.scheduler.active
+                else self.scheduler.admit(now, self.bucket_of)
+            )
         for state in admitted:
             self._event(
                 "request_admitted", state, slot=state.slot,
                 bucket=state.bucket, blocks=len(state.blocks),
                 queue_s=round(now - state.arrival_s, 6),
             )
-            self._admit_one(state)
+            with tel.span(
+                "prefill", step=self.step_count,
+                request_id=state.request.request_id, bucket=state.bucket,
+            ):
+                self._admit_one(state)
+        if self.gauge_every and self.step_count % self.gauge_every == 0:
+            # Engine-level gauges at a configurable cadence: queue depth
+            # and pool occupancy are the capacity-tuning signals
+            # (docs/OBSERVABILITY.md), too noisy to emit per request.
+            rec = serving_gauges(self.step_count, **self.scheduler.gauges())
+            self._emit(rec)
+            tel.note_event(rec)
         active = self.scheduler.active
         if not active:
             return not self.scheduler.idle
         cacheS = self._inject(self._cache, self._table, self._lens)
-        tok, rng, cacheS = self._decode_exe_or_compile()(
-            self._params, cacheS, self._tok[:, None], self._rng,
-            self._temp, self._top_k, self._top_p,
-        )
+        with tel.span(
+            "decode", step=self.step_count, batch=len(active)
+        ):
+            tok, rng, cacheS = self._decode_exe_or_compile()(
+                self._params, cacheS, self._tok[:, None], self._rng,
+                self._temp, self._top_k, self._top_p,
+            )
         self.calls["decode"] += 1
         self._cache = cacheS
         tok = np.asarray(tok)

@@ -278,3 +278,14 @@ class Scheduler:
             "used_blocks": self.pool.used_blocks,
             "block_high_water": self.pool.high_water,
         }
+
+    def gauges(self) -> dict:
+        """The instantaneous capacity gauges (``metrics.serving_gauges``
+        kwargs): queue depth + pool occupancy, the subset of :meth:`stats`
+        that changes every engine step and drives admission."""
+        return {
+            "pending": len(self.pending),
+            "active": len(self.active),
+            "free_blocks": self.pool.free_blocks,
+            "used_blocks": self.pool.used_blocks,
+        }

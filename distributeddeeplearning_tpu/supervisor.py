@@ -90,16 +90,55 @@ class SupervisorResult:
         return self.attempts[-1].kind if self.attempts else CLEAN
 
 
-def touch(path: str | None) -> None:
+def touch(path: str | None, *, step: int | None = None,
+          attempt: int | None = None, phase: str | None = None) -> None:
     """Create-or-touch a heartbeat file; never raises (a full disk must not
-    take the training run down with it)."""
+    take the training run down with it).
+
+    With any of ``step``/``attempt``/``phase`` the heartbeat CARRIES
+    content — ``{"step": N, "attempt": K, "phase": "..."}`` written
+    atomically (tmp + replace, so the monitor never reads a torn line) —
+    and the mtime still advances, so the hang detector's change-detection
+    contract is unchanged. Bare ``touch(path)`` keeps the legacy
+    mtime-only behavior (:func:`read_heartbeat` returns None for it)."""
     if not path:
         return
     try:
-        with open(path, "a"):
-            os.utime(path, None)
+        if step is None and attempt is None and phase is None:
+            with open(path, "a"):
+                os.utime(path, None)
+            return
+        rec: dict = {}
+        if step is not None:
+            rec["step"] = int(step)
+        if attempt is not None:
+            rec["attempt"] = int(attempt)
+        if phase is not None:
+            rec["phase"] = str(phase)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
     except OSError:
         pass
+
+
+def read_heartbeat(path: str | None) -> dict | None:
+    """The heartbeat's content, when the child wrote one (``touch`` with
+    fields): hang detection can then report WHERE the child hung — the
+    last step/attempt/phase it reached — instead of just that it did.
+    None for missing/empty/legacy-mtime-only heartbeats; never raises."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+        if not text:
+            return None
+        rec = json.loads(text)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
 
 
 class Supervisor:
@@ -124,6 +163,8 @@ class Supervisor:
         log_fn=None,
         mtime=os.path.getmtime,
         crash_clear_paths: tuple[str, ...] = (),
+        goodput_path: str | None = None,
+        flight_dir: str | None = None,
     ):
         self._cmd = list(cmd)
         self._cfg = cfg
@@ -138,6 +179,13 @@ class Supervisor:
         self._log = log_fn or (lambda rec: print(json.dumps(rec), flush=True))
         self._mtime = mtime
         self._crash_clear_paths = tuple(p for p in crash_clear_paths if p)
+        # Telemetry (telemetry.py; docs/OBSERVABILITY.md), both optional:
+        # goodput_path = the shared goodput.jsonl sidecar (the supervisor
+        # appends backoff records and emits the exit summary); flight_dir
+        # = where hang/crash kills dump a supervisor-side flight record
+        # (the SIGKILLed child cannot write its own).
+        self._goodput_path = goodput_path
+        self._flight_dir = flight_dir
         self._heartbeat = cfg.heartbeat_file or os.path.join(
             tempfile.gettempdir(), f"ddl_heartbeat_{os.getpid()}"
         )
@@ -205,10 +253,15 @@ class Supervisor:
                     rc = child.wait()
                     return CRASH, rc
             elif self._heartbeat_stale(last_change):
+                # Where did it hang? The content-bearing heartbeat (touch
+                # with fields) says which step/phase last reported in.
+                hb = read_heartbeat(self._heartbeat) or {}
                 self._log(
                     {
                         "event": "supervisor_hang_kill",
                         "hang_timeout_s": cfg.hang_timeout_s,
+                        "phase": hb.get("phase"),
+                        "hb_step": hb.get("step"),
                     }
                 )
                 child.kill()
@@ -267,8 +320,27 @@ class Supervisor:
                         }
                     )
                     return self._done(rc if rc else 1, attempts)
+                hb = read_heartbeat(self._heartbeat) or {}
                 if kind in (CRASH, HANG):
                     self._clear_suspect_state(kind)
+                    if self._flight_dir:
+                        # The killed/crashed child may not have written its
+                        # own flight record — preserve what the supervisor
+                        # knows (last heartbeat = last reported location).
+                        from .telemetry import dump_flight
+
+                        dump_flight(
+                            os.path.join(
+                                self._flight_dir,
+                                f"flight_supervisor_{kind}_attempt"
+                                f"{restarts}.json",
+                            ),
+                            reason=f"supervisor_{kind}",
+                            attempt=restarts,
+                            returncode=rc,
+                            heartbeat=hb or None,
+                            phase=hb.get("phase"),
+                        )
                 delay = self.backoff_s(restarts)
                 rec.backoff_s = delay
                 self._log(
@@ -277,8 +349,15 @@ class Supervisor:
                         "attempt": restarts + 1,
                         "after": kind,
                         "backoff_s": round(delay, 3),
+                        "phase": hb.get("phase"),
                     }
                 )
+                if self._goodput_path:
+                    # Backoff is pure non-goodput wall time the child never
+                    # sees; ledger it from the side that spends it.
+                    from .telemetry import record_backoff
+
+                    record_backoff(self._goodput_path, restarts + 1, delay)
                 self._sleep(delay)
                 restarts += 1
         finally:
@@ -312,6 +391,18 @@ class Supervisor:
             restarts=max(len(attempts) - 1, 0),
             attempts=attempts,
         )
+        if self._goodput_path:
+            # The exit goodput summary: every child attempt's ledger
+            # records + this supervisor's backoff records folded into one
+            # goodput_fraction (docs/OBSERVABILITY.md).
+            try:
+                from .telemetry import summarize_goodput
+
+                summary = summarize_goodput(self._goodput_path)
+            except Exception:
+                summary = None
+            if summary is not None:
+                self._log({"event": "goodput_summary", **summary})
         self._log(
             {
                 "event": "supervisor_done",

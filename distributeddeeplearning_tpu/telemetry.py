@@ -1,0 +1,701 @@
+"""Unified telemetry: span tracer, goodput ledger, device registry,
+flight recorder (``TelemetryConfig``; docs/OBSERVABILITY.md).
+
+The stack's performance subsystems (fused dispatch, bucketed overlap,
+hierarchical comms, ZeRO-1, mixed precision, paged serving, restart
+supervision) were previously observable only through scalar metrics and
+after-the-fact bench deltas. This module is the first-class substrate —
+the measurement discipline of the pjit/TPUv4 scaling study (PAPERS.md,
+arXiv 2204.06514) applied to this codebase:
+
+- :class:`SpanTracer` — hierarchical host-side spans (``step`` /
+  ``data_wait`` / ``dispatch`` / ``device_wait`` / ``checkpoint`` /
+  ``eval`` and the serving phases ``prefill`` / ``decode`` /
+  ``schedule``) in a bounded ring with strictly monotonic timestamps,
+  nestable via context manager, near-zero cost when disabled, exportable
+  as Chrome-trace/Perfetto JSON (matched B/E pairs) or a JSONL stream on
+  the PR-4 ``metrics.event_record`` shape.
+- :class:`GoodputLedger` — wall-clock decomposed into productive step
+  time vs. compile / data wait / checkpoint stalls / eval /
+  rollback-replayed steps / restart backoff, persisted across supervisor
+  restarts as an attempt-stamped JSONL sidecar; :func:`summarize_goodput`
+  folds every attempt + the supervisor's backoff records into one
+  ``goodput_fraction`` the supervisor emits on exit.
+- :class:`DeviceRegistry` — per-executable ``memory_analysis()``
+  (argument/output/temp/generated-code bytes), compile wall time, and
+  donation/recompile counters for every compiled step/serving program;
+  surfaced by ``benchmark.py`` and ``tools/telemetry_report.py``
+  (TELEMETRY.json).
+- :func:`dump_flight` — the crash flight recorder: on
+  fault/health-rollback/SIGTERM (and supervisor hang/crash kills) the
+  last N spans + events are dumped to a quarantine-adjacent file (the
+  default telemetry dir lives INSIDE ``train.checkpoint_dir``, next to
+  any ``<step>.corrupt`` quarantine) so chaos-run failures are
+  diagnosable from artifacts, not reconstruction.
+
+This module deliberately imports neither jax nor the rest of the package
+at module level: the supervisor (which must never touch the accelerator)
+reads/writes ledgers and flight files through it.
+
+Everything here is best-effort at the EDGES: recording is exact, but
+disk writes never raise — telemetry must not be the thing that takes a
+training run down.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from collections import deque
+
+# The span taxonomy (docs/OBSERVABILITY.md). Advisory, not enforced:
+# callers may open spans with other names, but the standard loop/serving
+# phases use exactly these so traces compare across runs.
+SPAN_NAMES = (
+    "step", "data_wait", "dispatch", "device_wait", "checkpoint", "eval",
+    "prefill", "decode", "schedule",
+)
+
+# Goodput ledger categories. "other" is the computed residual at attempt
+# close, so every attempt record's categories sum exactly to its wall.
+GOODPUT_CATEGORIES = (
+    "productive_step", "rollback_replay", "compile", "data_wait",
+    "checkpoint_stall", "eval", "restart_backoff", "other",
+)
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    name: str
+    t_start: float  # tracer-clock seconds, strictly monotonic per tracer
+    t_end: float
+    depth: int  # nesting depth at open (0 = top level)
+    args: dict
+
+
+class _NullSpan:
+    """The disabled-tracer context manager: one shared instance, no state,
+    so ``tracer.span(...)`` on a disabled tracer allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _SpanCM:
+    __slots__ = ("_tracer", "_name", "_args", "_start")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        tr = self._tracer
+        tr._stack.append(self._name)
+        self._start = tr._now()
+        return self
+
+    def __exit__(self, *exc):
+        tr = self._tracer
+        end = tr._now()
+        tr._stack.pop()
+        tr._ring.append(
+            Span(self._name, self._start, end, len(tr._stack), self._args)
+        )
+        return False
+
+
+class SpanTracer:
+    """Bounded-ring hierarchical span recorder.
+
+    ``with tracer.span("step", step=i): ...`` — spans nest (a context
+    manager per level); completed spans land in a ``deque(maxlen=
+    ring_size)``, so memory is bounded and the ring always holds the most
+    recent history (what the flight recorder wants). Timestamps come from
+    an injectable monotonic clock and are FENCED strictly increasing per
+    tracer, which is what makes the Chrome-trace export's B/E stream
+    well-formed by construction: sorting events by timestamp reproduces
+    the exact chronological open/close order, and dropping a ring-evicted
+    span removes a matched, properly-nested B/E pair.
+
+    Disabled tracers return a shared no-op context manager: the per-span
+    cost is one attribute check, no allocation, no clock read.
+    """
+
+    def __init__(self, enabled: bool = True, ring_size: int = 4096,
+                 clock=time.perf_counter):
+        self.enabled = bool(enabled)
+        self._clock = clock
+        self._ring: deque[Span] = deque(maxlen=int(ring_size))
+        self._stack: list[str] = []
+        self._last = 0.0
+
+    def _now(self) -> float:
+        t = self._clock()
+        if t <= self._last:
+            t = self._last + 1e-9
+        self._last = t
+        return t
+
+    def span(self, name: str, **args):
+        if not self.enabled:
+            return NULL_SPAN
+        return _SpanCM(self, name, args)
+
+    @property
+    def spans(self) -> list[Span]:
+        return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    # -- exports ------------------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """Chrome-trace/Perfetto JSON: one B and one E event per completed
+        span, microsecond timestamps relative to the oldest ringed span,
+        strictly increasing (rounding collisions are bumped by 1us so the
+        stream stays well-formed after integer truncation)."""
+        events = []
+        for s in self._ring:
+            events.append((s.t_start, "B", s))
+            events.append((s.t_end, "E", s))
+        events.sort(key=lambda e: e[0])
+        t0 = events[0][0] if events else 0.0
+        pid = os.getpid()
+        out = []
+        prev_us = -1
+        for t, ph, s in events:
+            us = int(round((t - t0) * 1e6))
+            if us <= prev_us:
+                us = prev_us + 1
+            prev_us = us
+            ev = {"name": s.name, "ph": ph, "ts": us, "pid": pid, "tid": 1,
+                  "cat": "host"}
+            if ph == "B" and s.args:
+                ev["args"] = dict(s.args)
+            out.append(ev)
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str) -> str | None:
+        return _write_json(path, self.chrome_trace())
+
+    def to_event_records(self) -> list[dict]:
+        """The ringed spans as PR-4 ``event_record``-shaped dicts — the
+        JSONL stream form (and what the flight recorder embeds)."""
+        out = []
+        for s in self._ring:
+            step = s.args.get("step", -1)
+            rec = {
+                "event": "span",
+                "step": int(step) if isinstance(step, (int, float)) else -1,
+                "span": s.name,
+                "depth": s.depth,
+                "t_s": round(s.t_start, 6),
+                "dur_ms": round((s.t_end - s.t_start) * 1e3, 4),
+            }
+            extra = {k: v for k, v in s.args.items() if k != "step"}
+            if extra:
+                rec.update(extra)
+            out.append(rec)
+        return out
+
+    def write_jsonl(self, path: str) -> str | None:
+        try:
+            with open(path, "w") as f:
+                for rec in self.to_event_records():
+                    f.write(json.dumps(rec) + "\n")
+            return path
+        except OSError:
+            return None
+
+
+def validate_chrome_trace(trace) -> list[str]:
+    """Structural validation of a Chrome-trace dict: returns a list of
+    problems (empty == valid). Checks: traceEvents list, non-decreasing
+    timestamps, and that B/E events pair up under stack discipline."""
+    problems: list[str] = []
+    if not isinstance(trace, dict) or not isinstance(
+        trace.get("traceEvents"), list
+    ):
+        return ["no traceEvents list"]
+    prev_ts = None
+    stack: list[str] = []
+    for i, ev in enumerate(trace["traceEvents"]):
+        if not isinstance(ev, dict) or "ph" not in ev or "ts" not in ev:
+            problems.append(f"event {i}: missing ph/ts")
+            continue
+        ts = ev["ts"]
+        if prev_ts is not None and ts < prev_ts:
+            problems.append(f"event {i}: ts {ts} < previous {prev_ts}")
+        prev_ts = ts
+        if ev["ph"] == "B":
+            stack.append(ev.get("name", ""))
+        elif ev["ph"] == "E":
+            if not stack:
+                problems.append(f"event {i}: E with empty stack")
+            elif stack[-1] != ev.get("name", ""):
+                problems.append(
+                    f"event {i}: E {ev.get('name')!r} does not match open "
+                    f"span {stack[-1]!r}"
+                )
+                stack.pop()
+            else:
+                stack.pop()
+    if stack:
+        problems.append(f"unclosed spans at end: {stack}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger
+# ---------------------------------------------------------------------------
+
+
+class GoodputLedger:
+    """Attempt-stamped goodput accounting, persisted as JSONL appends.
+
+    One ledger instance per process; ``open(start_step)`` /
+    ``close(final_step)`` bracket each training attempt (a supervised
+    restart is a new process → a new instance; an in-process health
+    rollback re-opens the same instance). Appends survive restarts — the
+    sidecar is the cross-attempt source of truth, and ``open`` re-reads
+    it so replayed steps (resume below a step some earlier attempt
+    already reached) are classified ``rollback_replay``, not productive.
+
+    ``clock`` is injectable (fake-clock tests); categories are plain
+    ``add(category, seconds)`` buckets except the residual ``other``,
+    computed at close so every attempt record sums exactly to its wall.
+    """
+
+    def __init__(self, path: str, *, attempt: int = 0, clock=time.monotonic):
+        self.path = path
+        self.attempt = int(attempt)
+        self._clock = clock
+        self._t_open: float | None = None
+        self._acc: dict[str, float] = {}
+        self._run = 0  # in-process open/close cycles (health rollbacks)
+        self._start_step = 0
+        self._max_step = 0
+        self._prior_max = -1
+        self._steps = {"productive": 0, "replayed": 0}
+
+    def open(self, start_step: int = 0) -> None:
+        self._t_open = self._clock()
+        self._acc = {}
+        self._start_step = int(start_step)
+        self._max_step = int(start_step)
+        self._steps = {"productive": 0, "replayed": 0}
+        self._prior_max = -1
+        for rec in read_goodput(self.path):
+            if rec.get("record") == "attempt":
+                self._prior_max = max(
+                    self._prior_max, int(rec.get("max_step", -1))
+                )
+
+    def add(self, category: str, seconds: float) -> None:
+        self._acc[category] = self._acc.get(category, 0.0) + float(seconds)
+
+    def step_time(self, seconds: float, end_step: int) -> None:
+        """Attribute one step interval's host time: productive when it
+        advances past every step a prior attempt already completed,
+        rollback-replay otherwise (re-earning lost ground is not
+        goodput)."""
+        end_step = int(end_step)
+        replay = end_step <= self._prior_max
+        self.add("rollback_replay" if replay else "productive_step", seconds)
+        self._steps["replayed" if replay else "productive"] += 1
+        self._max_step = max(self._max_step, end_step)
+
+    def close(self, final_step: int | None = None) -> dict | None:
+        if self._t_open is None:
+            return None
+        wall = self._clock() - self._t_open
+        self._t_open = None
+        if final_step is not None:
+            self._max_step = max(self._max_step, int(final_step))
+        cats = {k: round(v, 6) for k, v in self._acc.items()}
+        cats["other"] = round(max(wall - sum(self._acc.values()), 0.0), 6)
+        rec = {
+            "schema": 1,
+            "record": "attempt",
+            "attempt": self.attempt,
+            "run": self._run,
+            "wall_s": round(wall, 6),
+            "categories": cats,
+            "start_step": self._start_step,
+            "max_step": self._max_step,
+            "steps_productive": self._steps["productive"],
+            "steps_replayed": self._steps["replayed"],
+        }
+        self._run += 1
+        _append_jsonl(self.path, rec)
+        return rec
+
+
+def record_backoff(path: str, attempt: int, backoff_s: float) -> None:
+    """Supervisor-side ledger append: the backoff sleep before spawning
+    ``attempt`` is pure non-goodput wall time the child never sees."""
+    _append_jsonl(path, {
+        "schema": 1,
+        "record": "backoff",
+        "attempt": int(attempt),
+        "backoff_s": round(float(backoff_s), 6),
+    })
+
+
+def read_goodput(path: str) -> list[dict]:
+    """All parseable records in the sidecar (missing file -> []); a
+    torn/partial trailing line (crash mid-append) is skipped, not fatal."""
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def summarize_goodput(path: str) -> dict | None:
+    """Fold every attempt + backoff record into the exit summary:
+    total wall, per-category decomposition, and ``goodput_fraction`` =
+    productive step time / total wall. None when the sidecar is absent
+    or empty (no telemetry ran — absence must be visible, not zeroed)."""
+    records = read_goodput(path)
+    if not records:
+        return None
+    total = 0.0
+    cats: dict[str, float] = {}
+    attempts = 0
+    steps_productive = 0
+    steps_replayed = 0
+    for rec in records:
+        if rec.get("record") == "attempt":
+            attempts += 1
+            total += float(rec.get("wall_s", 0.0))
+            steps_productive += int(rec.get("steps_productive", 0))
+            steps_replayed += int(rec.get("steps_replayed", 0))
+            for k, v in (rec.get("categories") or {}).items():
+                cats[k] = cats.get(k, 0.0) + float(v)
+        elif rec.get("record") == "backoff":
+            b = float(rec.get("backoff_s", 0.0))
+            total += b
+            cats["restart_backoff"] = cats.get("restart_backoff", 0.0) + b
+    if total <= 0.0:
+        return None
+    return {
+        "wall_s": round(total, 6),
+        "categories": {k: round(v, 6) for k, v in sorted(cats.items())},
+        "goodput_fraction": round(cats.get("productive_step", 0.0) / total, 6),
+        "attempts": attempts,
+        "steps_productive": steps_productive,
+        "steps_replayed": steps_replayed,
+    }
+
+
+# ---------------------------------------------------------------------------
+# device registry
+# ---------------------------------------------------------------------------
+
+
+def memory_analysis_dict(compiled) -> dict | None:
+    """``compiled.memory_analysis()`` as plain ints, or None where the
+    backend doesn't report (guarded: HBM telemetry must never be what
+    crashes a run — same discipline as ``benchmark.device_memory_stats``).
+    The CPU sim DOES report argument/output/temp bytes (generated-code
+    bytes are legitimately 0 there)."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        return {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+            "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+        }
+    except Exception:
+        return None
+
+
+class DeviceRegistry:
+    """Per-executable compile/memory bookkeeping.
+
+    ``record(name, compiled, compile_s=...)`` is called wherever a step or
+    serving program is compiled (``fit``'s AOT pre-compile, the serving
+    engine's ``_compile``); a second record under the same name counts as
+    a recompile — steady state should show ``recompiles == 0`` everywhere
+    (the serving engine's test-pinned zero-recompile contract, now
+    visible as data)."""
+
+    def __init__(self):
+        self._entries: dict[str, dict] = {}
+
+    def record(self, name: str, compiled=None, *, compile_s: float | None =
+               None, donated_args: int = 0, **extra) -> dict:
+        entry = self._entries.get(name)
+        if entry is None:
+            entry = {
+                "name": name,
+                "compiles": 0,
+                "recompiles": 0,
+                "compile_s": 0.0,
+                "donated_args": int(donated_args),
+                "memory_analysis": None,
+            }
+            self._entries[name] = entry
+        entry["compiles"] += 1
+        entry["recompiles"] = entry["compiles"] - 1
+        if compile_s is not None:
+            entry["compile_s"] = round(entry["compile_s"] + compile_s, 6)
+        if donated_args:
+            entry["donated_args"] = int(donated_args)
+        if compiled is not None:
+            ma = memory_analysis_dict(compiled)
+            if ma is not None:
+                entry["memory_analysis"] = ma
+        if extra:
+            entry.update(extra)
+        return entry
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, name: str) -> dict | None:
+        return self._entries.get(name)
+
+    def to_dict(self) -> dict:
+        return {"executables": {k: dict(v) for k, v in self._entries.items()}}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def dump_flight(path: str, *, reason: str, tracer: SpanTracer | None = None,
+                events=(), last: int = 256, **extra) -> str | None:
+    """Write the crash flight record: the last ``last`` spans + events,
+    the reason, and any caller context (step, phase, heartbeat, ...).
+    Atomic (tmp + replace) and never raises — this runs on the way DOWN
+    (fault exits, SIGKILL-imminent hangs); a write failure must not mask
+    the original failure."""
+    rec = {
+        "schema": 1,
+        "reason": reason,
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        **extra,
+    }
+    spans = tracer.to_event_records() if tracer is not None else []
+    rec["spans"] = spans[-int(last):]
+    rec["events"] = list(events)[-int(last):]
+    return _write_json(path, rec)
+
+
+# ---------------------------------------------------------------------------
+# runtime bundle
+# ---------------------------------------------------------------------------
+
+
+def resolve_dir(cfg) -> str:
+    """The telemetry output dir for a full ``Config``: explicit
+    ``telemetry.dir`` wins; else quarantine-adjacent inside
+    ``train.checkpoint_dir`` (flight records land next to any
+    ``<step>.corrupt`` the checkpoint layer quarantined); else a temp
+    fallback so ``--telemetry`` without a checkpoint dir still works."""
+    if cfg.telemetry.dir:
+        return cfg.telemetry.dir
+    if cfg.train.checkpoint_dir:
+        return os.path.join(cfg.train.checkpoint_dir, "telemetry")
+    return os.path.join(tempfile.gettempdir(), "ddl_telemetry")
+
+
+class Telemetry:
+    """The wired-through bundle: one tracer + ledger + registry + event
+    ring, shared by fit / cli / the serving engine.
+
+    A disabled instance (``NULL_TELEMETRY``) is safe to thread
+    everywhere: ``span`` returns the shared no-op context manager,
+    ``note_event`` / ``record_exe`` / ``flight_dump`` return immediately,
+    and ``ledger`` is None — the instrumented loop pays one truthiness
+    check per hook.
+    """
+
+    def __init__(self, *, enabled: bool = True, out_dir: str | None = None,
+                 attempt: int = 0, ring_size: int = 4096,
+                 flight_last: int = 256, trace_file: str = "trace.json",
+                 goodput_file: str = "goodput.jsonl",
+                 span_clock=time.perf_counter, wall_clock=time.monotonic):
+        self.enabled = bool(enabled) and out_dir is not None
+        self.dir = out_dir
+        self.attempt = int(attempt)
+        self.flight_last = int(flight_last)
+        self._trace_file = trace_file
+        self.tracer = SpanTracer(
+            enabled=self.enabled, ring_size=ring_size, clock=span_clock
+        )
+        self.registry = DeviceRegistry()
+        self.events: deque = deque(maxlen=int(flight_last))
+        self.ledger = None
+        if self.enabled:
+            try:
+                os.makedirs(out_dir, exist_ok=True)
+            except OSError:
+                self.enabled = False
+                self.tracer.enabled = False
+                return
+            self.ledger = GoodputLedger(
+                os.path.join(out_dir, goodput_file),
+                attempt=attempt, clock=wall_clock,
+            )
+
+    @classmethod
+    def from_config(cls, cfg, *, attempt: int = 0) -> "Telemetry":
+        """Build from a full ``Config`` (NULL when telemetry is off)."""
+        t = cfg.telemetry
+        if not t.enabled:
+            return NULL_TELEMETRY
+        return cls(
+            enabled=True,
+            out_dir=resolve_dir(cfg),
+            attempt=attempt,
+            ring_size=t.ring_size,
+            flight_last=t.flight_last,
+            trace_file=t.trace_file,
+            goodput_file=t.goodput_file,
+        )
+
+    # -- hooks (all no-ops when disabled) -----------------------------------
+
+    def span(self, name: str, **args):
+        if not self.enabled:
+            return NULL_SPAN
+        return self.tracer.span(name, **args)
+
+    def note_event(self, record: dict) -> None:
+        """Mirror one emit-stream record into the flight-recorder ring."""
+        if self.enabled:
+            self.events.append(record)
+
+    def record_exe(self, name: str, compiled=None, **kw) -> None:
+        if self.enabled:
+            self.registry.record(name, compiled, **kw)
+
+    def record_compile(self, name: str, step_call, *args,
+                       donated_args: int = 0) -> None:
+        """AOT-compile ``step_call`` (``.lower(*args).compile()``), timing
+        the compile into the ledger and capturing its memory analysis.
+
+        NOTE: the AOT path does NOT share the traced-call executable cache
+        on this jax (verified empirically — both directions pay a full
+        compile), so this is a REAL extra compile. It belongs in tools that
+        acknowledge the cost (``tools/telemetry_report.py``, benchmark's
+        probe), never in the training hot loop — ``fit`` instead classifies
+        its first cold dispatch as ledger ``compile`` time and registers
+        the executable without a memory probe. Once per name: re-entry
+        must not re-pay or double-count."""
+        if not self.enabled or name in self.registry:
+            return
+        lower = getattr(step_call, "lower", None)
+        if lower is None:
+            return
+        try:
+            t0 = time.perf_counter()
+            compiled = lower(*args).compile()
+            dt = time.perf_counter() - t0
+        except Exception:
+            return
+        self.registry.record(
+            name, compiled, compile_s=dt, donated_args=donated_args
+        )
+        if self.ledger is not None:
+            self.ledger.add("compile", dt)
+
+    def flight_dump(self, reason: str, **extra) -> str | None:
+        if not self.enabled:
+            return None
+        path = os.path.join(
+            self.dir, f"flight_{reason}_attempt{self.attempt}.json"
+        )
+        return dump_flight(
+            path, reason=reason, tracer=self.tracer, events=self.events,
+            last=self.flight_last, attempt=self.attempt, **extra,
+        )
+
+    def write_trace(self) -> str | None:
+        """Write (atomically replace) the Chrome trace + span JSONL from
+        the current ring. Idempotent; called at every attempt boundary so
+        the newest trace survives whatever happens next."""
+        if not self.enabled:
+            return None
+        self.tracer.write_jsonl(os.path.join(self.dir, "spans.jsonl"))
+        return self.tracer.write_chrome_trace(
+            os.path.join(self.dir, self._trace_file)
+        )
+
+    @property
+    def trace_path(self) -> str | None:
+        if not self.enabled:
+            return None
+        return os.path.join(self.dir, self._trace_file)
+
+
+NULL_TELEMETRY = Telemetry(enabled=False, out_dir=None)
+
+
+# ---------------------------------------------------------------------------
+# small io helpers (never raise)
+# ---------------------------------------------------------------------------
+
+
+def _write_json(path: str, obj) -> str | None:
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def _append_jsonl(path: str, rec: dict) -> None:
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+    except OSError:
+        pass

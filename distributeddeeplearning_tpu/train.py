@@ -1760,6 +1760,7 @@ def fit(
     eval_fn=None,
     health=None,
     heartbeat_file: str | None = None,
+    telemetry=None,
 ) -> tuple[TrainState, list[dict]]:
     """Host step loop.
 
@@ -1804,14 +1805,27 @@ def fit(
       reports ``max_consecutive_anomalies`` consecutive anomalous steps
       (detection lags one logging interval — the deferred-fetch contract),
       raises :class:`HealthRollback` for the caller's restore-and-retry.
+
+    Telemetry (``telemetry.Telemetry``; docs/OBSERVABILITY.md): when an
+    enabled bundle is passed, the loop opens host-side spans (``step`` >
+    ``data_wait``/``dispatch``/``device_wait``, plus ``checkpoint`` and
+    ``eval``), attributes wall time to the goodput ledger (productive vs
+    compile / data wait / checkpoint stall / eval / rollback replay — the
+    first cold dispatch, which compiles inside the call, is classified
+    ``compile`` and registered in the device registry), and dumps a
+    flight record on every fault / rollback / preemption path. Disabled
+    (the default None) costs one
+    truthiness check per hook. Heartbeat touches carry ``{step, attempt,
+    phase}`` so the supervisor's hang kill can say WHERE the child hung.
     """
     import os
     import signal
     import sys
 
     from .metrics import DeferredMetrics, event_record
-    from .supervisor import EXIT_FAULT, HEARTBEAT_ENV
+    from .supervisor import ATTEMPT_ENV, EXIT_FAULT, HEARTBEAT_ENV
     from .supervisor import touch as hb_touch
+    from .telemetry import NULL_TELEMETRY
 
     if eval_every and eval_fn is None:
         raise ValueError("eval_every > 0 requires eval_fn")
@@ -1832,12 +1846,22 @@ def fit(
     max_consec = (
         health.max_consecutive_anomalies if health is not None else 0
     )
+    tel = telemetry if telemetry is not None else NULL_TELEMETRY
+    ledger = tel.ledger
+    attempt = int(os.environ.get(ATTEMPT_ENV, "0") or 0)
+
+    def beat(phase, at):
+        # Content-bearing heartbeat: mtime still advances (the hang
+        # detector's change signal), and the supervisor can now report
+        # the last {step, attempt, phase} the loop reached.
+        hb_touch(hb, step=at, attempt=attempt, phase=phase)
 
     history = []
 
     def emit(m):
         history.append(m)
         log_fn(m)
+        tel.note_event(m)
         if writer is not None and "event" not in m:
             writer.write(m["step"], {x: v for x, v in m.items() if x != "step"})
         if max_consec and m.get("consecutive_anomalies", 0) >= max_consec:
@@ -1851,7 +1875,11 @@ def fit(
         # evaluate() is a sync point anyway; draining the deferred log first
         # keeps the train line for step N ahead of its eval line.
         deferred.flush()
-        m = evaluate(trainer, state, eval_fn())
+        t_ev = time.perf_counter()
+        with tel.span("eval", step=end):
+            m = evaluate(trainer, state, eval_fn())
+        if ledger is not None:
+            ledger.add("eval", time.perf_counter() - t_ev)
         m["step"] = end
         emit(m)
 
@@ -1870,7 +1898,7 @@ def fit(
     t0 = time.perf_counter()
     it = iter(batches)
     end = start
-    hb_touch(hb)
+    beat("start", start)
     try:
         for i in range(start, steps, k):
             if preempt["signum"] is not None:
@@ -1879,23 +1907,44 @@ def fit(
                 # time Preempted propagates, the state IS durable.
                 saved = False
                 if ckpt is not None:
-                    if ckpt.latest_step() != end:
-                        ckpt.save(end, state, {"next_index": end}, force=True)
-                    ckpt.wait()
+                    with tel.span("checkpoint", step=end, forced=True):
+                        if ckpt.latest_step() != end:
+                            ckpt.save(
+                                end, state, {"next_index": end}, force=True
+                            )
+                        ckpt.wait()
                     saved = True
                 deferred.emit_event(event_record(
                     "preempt_save", end, saved=saved,
                     signum=int(preempt["signum"]),
                 ))
+                tel.flight_dump(
+                    "preempt", step=end, phase="preempt",
+                    signum=int(preempt["signum"]), saved=saved,
+                )
+                tel.write_trace()
                 sys.stdout.flush()
                 raise Preempted(end, saved)
             if fault is not None and i == fault.step and fault.kind != "nan":
+                # Injected faults exit via os._exit (or never return), so
+                # the caller's finally can't run: the attempt's ledger
+                # record and flight/trace files are written HERE or lost.
                 if fault.kind == "step":
                     deferred.emit_event(event_record("fault_kill", i))
+                    tel.flight_dump("fault_kill", step=i, phase="fault")
+                    tel.write_trace()
+                    if ledger is not None:
+                        ledger.close(i)
                     sys.stdout.flush()
                     os._exit(EXIT_FAULT)
                 if fault.kind == "hang":
                     deferred.emit_event(event_record("fault_hang", i))
+                    # Dump BEFORE the stall: the supervisor's recovery is
+                    # SIGKILL, after which this process writes nothing.
+                    tel.flight_dump("fault_hang", step=i, phase="fault")
+                    tel.write_trace()
+                    if ledger is not None:
+                        ledger.close(i)
                     sys.stdout.flush()
                     while True:  # heartbeat stale -> supervisor SIGKILLs
                         time.sleep(3600)
@@ -1905,14 +1954,57 @@ def fit(
                     deferred.emit_event(event_record(
                         "fault_corrupt", i, corrupted_step=bad
                     ))
+                    tel.flight_dump("fault_corrupt", step=i, phase="fault")
+                    tel.write_trace()
+                    if ledger is not None:
+                        ledger.close(i)
                     sys.stdout.flush()
                     os._exit(EXIT_FAULT)
-            hb_touch(hb)
-            try:
-                batch = next(it)
-            except StopIteration:
+            beat("step", end)
+            stop = False
+            with tel.span("step", step=i):
+                t_dw = time.perf_counter()
+                try:
+                    with tel.span("data_wait", step=i):
+                        batch = next(it)
+                except StopIteration:
+                    stop = True
+                if not stop:
+                    if ledger is not None:
+                        ledger.add(
+                            "data_wait", time.perf_counter() - t_dw
+                        )
+                    # The first dispatch in this process traces + compiles
+                    # inside the call (the AOT .lower().compile() path
+                    # would NOT seed the traced-call cache on this jax —
+                    # it costs a full SECOND compile), so the honest
+                    # accounting is: classify the whole first dispatch as
+                    # "compile" and register the executable without a
+                    # memory probe (benchmark.py/telemetry_report own that
+                    # probe and its extra compile). Registry presence
+                    # doubles as the warm-cache marker, so a health-
+                    # rollback re-entry goes back to step accounting.
+                    step_name = (
+                        "train_step" if k == 1 else f"fused_train_step_{k}"
+                    )
+                    cold = tel.enabled and step_name not in tel.registry
+                    t_disp = time.perf_counter()
+                    with tel.span("dispatch", step=i):
+                        state, metrics = step_call(state, batch)
+                    dt_disp = time.perf_counter() - t_disp
+                    if cold:
+                        tel.record_exe(
+                            step_name, None, compile_s=dt_disp,
+                            donated_args=1,
+                        )
+                        if ledger is not None:
+                            ledger.add("compile", dt_disp)
+                    elif ledger is not None:
+                        # productive vs rollback_replay: re-earning ground
+                        # a prior attempt already covered is not goodput.
+                        ledger.step_time(dt_disp, i + k)
+            if stop:
                 break
-            state, metrics = step_call(state, batch)
             end = i + k
             if profiler is not None:
                 # Per-step granularity for the window bounds; under fusion
@@ -1926,24 +2018,34 @@ def fit(
                     metrics if k == 1
                     else jax.tree.map(lambda v: v[-1], metrics)
                 )
-                deferred.push(
-                    end, last, wall_s=round(time.perf_counter() - t0, 3)
-                )
-                # push materialized the PREVIOUS interval — a real D2H sync
-                # — so this touch is the honest device-liveness signal.
-                hb_touch(hb)
+                # push materializes the PREVIOUS interval — a real D2H
+                # sync — which is exactly what the device_wait span times.
+                with tel.span("device_wait", step=end):
+                    deferred.push(
+                        end, last, wall_s=round(time.perf_counter() - t0, 3)
+                    )
+                # ... so this touch is the honest device-liveness signal.
+                beat("log", end)
             if eval_every and end % eval_every == 0:
                 run_eval(end)
-                hb_touch(hb)
+                beat("eval", end)
             if ckpt is not None and save_every and end % save_every == 0:
-                ckpt.save(end, state, {"next_index": end})
-                if fault is not None:
-                    # Fault injection simulates a crash at an arbitrary
-                    # step; the recovery contract is "resume from the last
-                    # DURABLE save". Draining here makes every completed
-                    # save durable, so crash→resume is deterministic
-                    # instead of racing the async writer (ADVICE.md r1).
-                    ckpt.wait()
+                t_ck = time.perf_counter()
+                with tel.span("checkpoint", step=end):
+                    ckpt.save(end, state, {"next_index": end})
+                    if fault is not None:
+                        # Fault injection simulates a crash at an arbitrary
+                        # step; the recovery contract is "resume from the
+                        # last DURABLE save". Draining here makes every
+                        # completed save durable, so crash→resume is
+                        # deterministic instead of racing the async writer
+                        # (ADVICE.md r1).
+                        ckpt.wait()
+                if ledger is not None:
+                    ledger.add(
+                        "checkpoint_stall", time.perf_counter() - t_ck
+                    )
+                beat("save", end)
         if eval_every and end % eval_every != 0 and end > start:
             run_eval(end)  # final eval so short runs still report one
         deferred.flush()
@@ -1954,6 +2056,11 @@ def fit(
         emit(event_record(
             "health_rollback", rb.step, consecutive=rb.consecutive
         ))
+        tel.flight_dump(
+            "health_rollback", step=rb.step, phase="rollback",
+            consecutive=rb.consecutive,
+        )
+        tel.write_trace()
         sys.stdout.flush()
         raise
     finally:

@@ -47,6 +47,9 @@ _HEADLINE_KEYS = (
     "state_bytes_reduction_vs_fp32", "grad_sync_reduction_vs_fp32",
     "dispatch_overhead_ms_per_step", "unfused_steps_per_sec",
     "fused_steps_per_sec", "rc", "ok", "n", "n_devices", "shrunk",
+    # BENCH_TELEMETRY.json (tools/telemetry_report.py): the instrumented
+    # loop's cost, pinned ≤ 2% of steps/s by tests.
+    "overhead_fraction", "enabled_steps_per_sec", "disabled_steps_per_sec",
 )
 
 
