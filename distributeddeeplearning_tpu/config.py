@@ -344,6 +344,18 @@ class ServingConfig:
     # Emit queue-depth / free-block gauges (metrics.serving_gauges) every
     # this many engine steps through the engine's event stream. 0 = off.
     gauge_every: int = 0
+    # Paged-attention read path for the decode hot loop: 'reference'
+    # (gather each row's pages per layer per step) or 'pallas' (the fused
+    # ops/paged_attention.py kernel reads the pool in place via
+    # scalar-prefetch page-table indirection; interpret mode off-TPU, so
+    # both paths run everywhere). Requires block_size % 8 == 0 (sublane
+    # tile) — fenced at config time.
+    attn_kernel: str = "reference"
+    # Prefill/decode priority: cap request admissions (one prefill each)
+    # per engine step so queue bursts interleave between decode steps
+    # instead of stalling the running batch. 0 = uncapped (admit while
+    # lanes + blocks last).
+    max_prefills_per_step: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -44,6 +44,9 @@ class GPT2(nn.Module):
     # pages_per_seq) — per-row cursors, block-pool KV storage
     # (transformer.paged_decode_attention). Requires decode=True.
     kv_pages: tuple | None = None
+    # Paged read path: 'reference' (gather) or 'pallas' (fused in-place
+    # kernel, ops/paged_attention.py) — serving.attn_kernel.
+    paged_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -129,6 +132,7 @@ class GPT2(nn.Module):
             mesh=self.mesh,
             decode=self.decode,
             kv_pages=self.kv_pages,
+            paged_kernel=self.paged_kernel,
             name="h",
         )(x, None, not train)
         x = layer_norm(1e-5, self.dtype, "ln_f")(x)

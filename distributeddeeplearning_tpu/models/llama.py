@@ -108,6 +108,9 @@ class LlamaAttention(nn.Module):
     # Paged serving cache (transformer.paged_decode_attention): per-row
     # cursors + block-pool KV storage. Requires decode=True.
     kv_pages: tuple | None = None
+    # Paged read path: 'reference' (gather) or 'pallas' (fused in-place
+    # kernel, ops/paged_attention.py) — serving.attn_kernel.
+    paged_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, x):
@@ -185,7 +188,7 @@ class LlamaAttention(nn.Module):
                 )
             out = paged_decode_attention(
                 self, q, k, v, dtype=self.dtype, kv_pages=self.kv_pages,
-                num_rep=rep, lens_var=lens_var,
+                num_rep=rep, lens_var=lens_var, kernel=self.paged_kernel,
             )
         elif self.decode:
             out = decode_attention(
@@ -296,6 +299,7 @@ class LlamaBlock(nn.Module):
     constrain_out: bool = True
     decode: bool = False  # KV-cache decoding
     kv_pages: tuple | None = None  # paged serving cache (LlamaAttention)
+    paged_kernel: str = "reference"  # paged read path (LlamaAttention)
 
     @nn.compact
     def __call__(self, x):
@@ -304,7 +308,8 @@ class LlamaBlock(nn.Module):
             rope_theta=self.rope_theta, dtype=self.dtype,
             attn_impl=self.attn_impl, mesh=self.mesh,
             psum_axis=self.psum_axis, manual_tp_ad=self.manual_tp_ad,
-            decode=self.decode, kv_pages=self.kv_pages, name="attn",
+            decode=self.decode, kv_pages=self.kv_pages,
+            paged_kernel=self.paged_kernel, name="attn",
         )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
         if self.constrain_out:
             x = constrain(x, "batch", "seq", "embed")
@@ -336,6 +341,9 @@ class Llama(nn.Module):
     # Paged serving cache (serving/engine.py): per-row cursors + block-pool
     # KV storage (transformer.paged_decode_attention). Requires decode=True.
     kv_pages: tuple | None = None
+    # Paged read path: 'reference' (gather) or 'pallas' (fused in-place
+    # kernel, ops/paged_attention.py) — serving.attn_kernel.
+    paged_kernel: str = "reference"
     # True: the LM head shares the embedding table (Llama-3.2-class small
     # checkpoints; HF tie_word_embeddings) — no separate lm_head param.
     tie_embeddings: bool = False
@@ -366,6 +374,7 @@ class Llama(nn.Module):
                 rope_theta=self.rope_theta, rms_eps=self.rms_eps,
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
                 decode=self.decode, kv_pages=self.kv_pages,
+                paged_kernel=self.paged_kernel,
                 name=f"block_{i}",
             )(x)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)

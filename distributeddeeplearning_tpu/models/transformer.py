@@ -127,7 +127,8 @@ def _cache_attend(q, ck, cv, visible, num_rep: int, dtype):
 
 
 def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
-                           num_rep: int = 1, lens_var=None):
+                           num_rep: int = 1, lens_var=None,
+                           kernel: str = "reference"):
     """Decode/prefill attention against a PAGED KV cache (serving engine).
 
     Instead of one contiguous [B, max_len] cache per sequence, k/v live in a
@@ -153,10 +154,25 @@ def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
     L == 1 is one decode step; L > 1 is bulk prefill (positions beyond the
     prompt's real length write pad KV into the row's own reserved pages and
     are overwritten by real decode tokens later; causal masking hides them
-    from every real query). The gather materializes [B, pages*bs] per layer
-    — the CPU-sim reference lowering; a Pallas paged-attention kernel
-    (ops/, roadmap) replaces it on chip.
+    from every real query).
+
+    ``kernel`` selects the read path (``serving.attn_kernel``):
+    - ``reference``: gather each row's pages into a contiguous
+      [B, pages*bs] view and run ``_cache_attend`` — materializes the
+      gathered cache per layer per step (the CPU-sim reference lowering);
+    - ``pallas``: the fused ``ops/paged_attention`` kernel reads the pool
+      IN PLACE via scalar-prefetch page-table indirection (interpret mode
+      off-TPU, so parity is tested everywhere). Decode steps (L == 1)
+      only: bulk prefill runs once per request and keeps the gather —
+      the hot loop is the per-step decode.
+
+    The pool WRITE (scatter at the cursor) is the same XLA
+    scatter-at-indices in both modes; only the read side differs.
     """
+    if kernel not in ("reference", "pallas"):
+        raise ValueError(
+            f"paged kernel must be 'reference' or 'pallas', got {kernel!r}"
+        )
     num_blocks, bs, pages = kv_pages
     B, L, Hkv, D = k.shape
     pk = module.variable(
@@ -188,12 +204,32 @@ def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
     pv.value = pv.value.reshape(num_blocks * bs, Hkv, D).at[flat].set(
         v.reshape(B * L, Hkv, D)
     ).reshape(pv.value.shape)
-    # Gather each row's pages into logical order: [B, pages*bs, Hkv, D].
-    ck = pk.value[table.value].reshape(B, pages * bs, Hkv, D)
-    cv = pv.value[table.value].reshape(B, pages * bs, Hkv, D)
-    cols = jnp.arange(pages * bs)
-    visible = cols[None, None, :] <= pos[:, :, None]  # causal within the row
-    out = _cache_attend(q, ck, cv, visible, num_rep, dtype)
+    if kernel == "pallas" and L == 1:
+        from ..ops.paged_attention import paged_attention
+
+        out = paged_attention(
+            q[:, 0], pk.value, pv.value, table.value, lens.value,
+            num_rep=num_rep,
+        )[:, None]
+    else:
+        # Gather each row's pages into logical order: [B, pages*bs, Hkv, D].
+        ck = pk.value[table.value].reshape(B, pages * bs, Hkv, D)
+        cv = pv.value[table.value].reshape(B, pages * bs, Hkv, D)
+        cols = jnp.arange(pages * bs)
+        visible = cols[None, None, :] <= pos[:, :, None]  # causal per row
+        out = _cache_attend(q, ck, cv, visible, num_rep, dtype)
+    if jax.config.jax_enable_checks:
+        # Debug-mode OOB tripwire (train.debug_checks): XLA clamps OOB
+        # gather/scatter indices SILENTLY, so a corrupt page table reads —
+        # and scatter-writes — the wrong physical block instead of
+        # failing (same hazard models/gpt2.py guards in the embedding
+        # path). Whether an entry is in range is data-dependent, so it
+        # cannot raise under jit — poison the offending rows to NaN
+        # instead (loud under debug_nans / any downstream check), the
+        # flash non-prefix-mask idiom. The serving engine additionally
+        # range-checks every host-built table before injection.
+        bad = ((table.value < 0) | (table.value >= num_blocks)).any(axis=1)
+        out = jnp.where(bad[:, None, None, None], jnp.nan, out)
     lens.value = lens.value + L
     return out
 
@@ -319,6 +355,9 @@ class SelfAttention(nn.Module):
     # PAGED block-pool layout with per-row cursors (paged_decode_attention)
     # instead of the contiguous per-sequence cache.
     kv_pages: tuple | None = None
+    # Paged read path: 'reference' (gather) or 'pallas' (in-place fused
+    # kernel, ops/paged_attention.py) — serving.attn_kernel.
+    paged_kernel: str = "reference"
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -356,7 +395,8 @@ class SelfAttention(nn.Module):
                         f"{self.attn_impl!r}"
                     )
                 out = paged_decode_attention(
-                    self, q, k, v, dtype=self.dtype, kv_pages=self.kv_pages
+                    self, q, k, v, dtype=self.dtype, kv_pages=self.kv_pages,
+                    kernel=self.paged_kernel,
                 )
             else:
                 out = decode_attention(self, q, k, v, dtype=self.dtype,
@@ -522,6 +562,7 @@ class TransformerBlock(nn.Module):
     manual_tp_ad: bool = False  # see SelfAttention.manual_tp_ad
     decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
     kv_pages: tuple | None = None  # paged serving cache (SelfAttention)
+    paged_kernel: str = "reference"  # paged read path (SelfAttention)
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -538,6 +579,7 @@ class TransformerBlock(nn.Module):
             manual_tp_ad=self.manual_tp_ad,
             decode=self.decode,
             kv_pages=self.kv_pages,
+            paged_kernel=self.paged_kernel,
             name="attn",
         )
         mlp = Mlp(
@@ -584,6 +626,7 @@ class TransformerStack(nn.Module):
     mesh: object = None
     decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
     kv_pages: tuple | None = None  # paged serving cache (SelfAttention)
+    paged_kernel: str = "reference"  # paged read path (SelfAttention)
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -612,6 +655,7 @@ class TransformerStack(nn.Module):
                 mesh=self.mesh,
                 decode=self.decode,
                 kv_pages=self.kv_pages,
+                paged_kernel=self.paged_kernel,
                 name=f"block_{i}",
             )(x, mask, deterministic)
         return x

@@ -12,5 +12,9 @@ interpret-mode tests.
 
 from .flash_attention import attention_reference, flash_attention  # noqa: F401
 from .fused_adamw import fused_adamw  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    paged_attention,
+    paged_attention_reference,
+)
 from .ring_attention import ring_attention  # noqa: F401
 from .ring_attention_pallas import ring_attention_pallas  # noqa: F401

@@ -1,0 +1,219 @@
+"""Paged-attention decode kernel — Pallas Mosaic, for the serving engine.
+
+The serving hot loop (``serving/engine.py``) decodes ONE token per row
+against a block-pooled KV cache. The reference lowering
+(``transformer.paged_decode_attention``) gathers each row's pages into a
+contiguous ``[B, pages*block_size]`` view per layer per step — correct,
+but it materializes the whole gathered cache in HBM every decode step.
+This kernel reads the pool IN PLACE: the page table rides in as a
+scalar-prefetch operand, so each grid step's BlockSpec index_map resolves
+``page_table[b, j]`` and the DMA engine fetches exactly that physical
+block — no gathered copy exists at any point.
+
+Layout (see pallas_guide.md and ops/flash_attention.py, the idiom seed):
+- grid is ``(batch, kv_heads, pages_per_seq)`` — pages innermost, which
+  is sequential on TPU, so the online-softmax carries (m, l, acc) live in
+  VMEM scratch across a row's pages;
+- ``pltpu.PrefetchScalarGridSpec(num_scalar_prefetch=2)``: the page
+  table and the per-row cursors are scalar operands available to BOTH the
+  index_maps (physical block selection) and the kernel body (causal
+  masking at the row's cursor);
+- GQA: q arrives group-major (query head ``g*num_rep + r`` reads kv
+  group ``g``, matching ``transformer._cache_attend``) and is reshaped to
+  ``[B, kv_heads, num_rep, D]`` — each grid step attends its group's
+  ``num_rep`` query heads against ONE un-repeated kv block, so the pool
+  is never repeated to the query head count;
+- pages entirely beyond a row's cursor are skipped with ``pl.when`` (no
+  MXU work, no DMA wait on the accumulate path); the cursor page is
+  masked per-column with ``broadcasted_iota``;
+- all accumulation is fp32 (``preferred_element_type``) regardless of
+  pool dtype; on CPU backends the kernel runs in interpret mode, which is
+  how the parity tests exercise it without a TPU (native compilation is
+  covered under the ``tpu_only`` gate).
+
+Semantics match the reference gather exactly: the caller has already
+scattered this step's k/v into the pool at position ``seq_lens[b]``, and
+row b attends columns ``0 .. seq_lens[b]`` inclusive. Idle rows (cursor
+0, page table parked on the null block) attend exactly position 0 of the
+null block — same as the reference; the engine discards their output.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # finite: exp(_NEG_INF - m) == 0 exactly, no inf-inf NaNs
+_LANES = 128
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(
+    table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale, block_size, num_pages,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = lens_ref[b]  # this row's query position (cursor, pre-advance)
+
+    # Pages strictly beyond the cursor hold no visible columns — skip.
+    @pl.when(j * block_size <= pos)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (num_rep, D)
+        k = k_ref[0, :, 0].astype(jnp.float32)  # (block_size, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (num_rep, block_size)
+        col = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(col <= pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]  # (num_rep, 1)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v_ref[0, :, 0].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q, pool_k, pool_v, page_table, seq_lens, *,
+    num_rep: int = 1,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+):
+    """One decode step of attention against the paged KV pool, in place.
+
+    - ``q``: [B, H, D] — ONE query token per row, heads group-major over
+      kv groups (H = kv_heads * num_rep);
+    - ``pool_k`` / ``pool_v``: [num_blocks, block_size, kv_heads, D] —
+      the shared block pool (un-repeated kv under GQA);
+    - ``page_table``: [B, pages_per_seq] int32 — row b's logical page j
+      lives in physical pool block ``page_table[b, j]``. Every entry must
+      be a valid block id; out-of-range ids read whatever block the DMA
+      clamps to (the caller fails loudly first — see
+      ``transformer.paged_decode_attention``);
+    - ``seq_lens``: [B] int32 — the row's cursor BEFORE this token
+      advances it: row b attends columns ``0 .. seq_lens[b]`` of its
+      logical sequence (its own just-written k/v included).
+
+    Returns [B, H, D] in q's dtype. ``interpret=None`` auto-selects
+    interpret mode off-TPU (the CPU test harness).
+    """
+    B, H, D = q.shape
+    num_blocks, block_size, kv_heads, Dk = pool_k.shape
+    if pool_v.shape != pool_k.shape:
+        raise ValueError(
+            f"pool_k/pool_v shapes differ: {pool_k.shape} {pool_v.shape}"
+        )
+    if Dk != D or H != kv_heads * num_rep:
+        raise ValueError(
+            f"q [B,H,D]={q.shape} incompatible with pool "
+            f"[NB,bs,kv_heads,D]={pool_k.shape} at num_rep={num_rep}"
+        )
+    num_pages = page_table.shape[-1]
+    if page_table.shape != (B, num_pages) or seq_lens.shape != (B,):
+        raise ValueError(
+            f"page_table {page_table.shape} / seq_lens {seq_lens.shape} "
+            f"must be [B={B}, pages] / [B={B}]"
+        )
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(D))
+    if interpret is None:
+        interpret = _default_interpret()
+
+    # Group-major head fold: head g*num_rep+r -> (group g, rep r).
+    q4 = q.reshape(B, kv_heads, num_rep, D)
+    kernel = functools.partial(
+        _decode_kernel,
+        sm_scale=sm_scale, block_size=block_size, num_pages=num_pages,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, kv_heads, num_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, num_rep, D), lambda b, g, j, tbl, lens: (b, g, 0, 0)
+            ),
+            # The paged read: physical block straight off the table.
+            pl.BlockSpec(
+                (1, block_size, 1, D),
+                lambda b, g, j, tbl, lens: (tbl[b, j], 0, g, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_size, 1, D),
+                lambda b, g, j, tbl, lens: (tbl[b, j], 0, g, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, num_rep, D), lambda b, g, j, tbl, lens: (b, g, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_rep, _LANES), jnp.float32),
+            pltpu.VMEM((num_rep, _LANES), jnp.float32),
+            pltpu.VMEM((num_rep, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, kv_heads, num_rep, D), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(page_table, jnp.int32), jnp.asarray(seq_lens, jnp.int32),
+        q4, pool_k, pool_v,
+    )
+    return out.reshape(B, H, D)
+
+
+def paged_attention_reference(q, pool_k, pool_v, page_table, seq_lens, *,
+                              num_rep: int = 1):
+    """Pure-jnp oracle: the engine's gather lowering, kernel-level shapes.
+
+    Same math as ``transformer.paged_decode_attention``'s reference path
+    (gather pages -> mask ``col <= cursor`` -> fp32 softmax), restated on
+    the kernel's [B, H, D] single-token signature for parity tests.
+    """
+    B, H, D = q.shape
+    nb, bs, kv_heads, _ = pool_k.shape
+    pages = page_table.shape[-1]
+    ck = pool_k[page_table].reshape(B, pages * bs, kv_heads, D)
+    cv = pool_v[page_table].reshape(B, pages * bs, kv_heads, D)
+    qg = q.reshape(B, kv_heads, num_rep, D)
+    s = jnp.einsum("bgrd,bkgd->bgrk", qg, ck).astype(jnp.float32)
+    s = s / np.sqrt(D)
+    cols = jnp.arange(pages * bs)
+    s = jnp.where(
+        cols[None, None, None, :] <= seq_lens[:, None, None, None],
+        s, _NEG_INF,
+    )
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bgrk,bkgd->bgrd", p, cv.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)

@@ -107,6 +107,24 @@ def check_serving_composition(cfg) -> None:
             "serving.prompt_buckets must be strictly increasing positive "
             f"lengths, got {s.prompt_buckets!r}"
         )
+    kernel = getattr(s, "attn_kernel", "reference")
+    if kernel not in ("reference", "pallas"):
+        raise ValueError(
+            "serving.attn_kernel must be 'reference' or 'pallas', got "
+            f"{kernel!r}"
+        )
+    if kernel == "pallas" and s.block_size % 8:
+        raise NotImplementedError(
+            f"serving.attn_kernel='pallas' x block_size={s.block_size}: "
+            "the kernel streams whole pool blocks through the (8, 128) "
+            "sublane tile, so block_size must be a multiple of 8 — pick a "
+            "multiple of 8 or keep attn_kernel='reference'"
+        )
+    if getattr(s, "max_prefills_per_step", 0) < 0:
+        raise ValueError(
+            "serving.max_prefills_per_step must be >= 0 (0 = uncapped), "
+            f"got {s.max_prefills_per_step}"
+        )
 
 
 class ServingEngine:
@@ -187,7 +205,29 @@ class ServingEngine:
             )
         self.block_bytes = block_bytes
         self.kv_pages = (self.num_blocks, bs, self.pages)
-        self.model = model.clone(decode=True, kv_pages=self.kv_pages)
+        # Paged read path (docs/SERVING.md hot path): 'reference' gathers
+        # every row's pages per layer per step; 'pallas' reads the pool in
+        # place (ops/paged_attention.py — interpret mode off-TPU, so both
+        # modes run and parity-test everywhere).
+        self.attn_kernel = str(getattr(cfg, "attn_kernel", "reference"))
+        if self.attn_kernel not in ("reference", "pallas"):
+            raise ValueError(
+                "serving.attn_kernel must be 'reference' or 'pallas', got "
+                f"{self.attn_kernel!r}"
+            )
+        self.model = model.clone(
+            decode=True, kv_pages=self.kv_pages,
+            paged_kernel=self.attn_kernel,
+        )
+        # Prefill/decode priority: cap admissions (each costs one prefill)
+        # per engine step so a queue burst cannot stall the running decode
+        # batch behind back-to-back prefills. 0 = admit while lanes last.
+        self.max_prefills = int(getattr(cfg, "max_prefills_per_step", 0))
+        if self.max_prefills < 0:
+            raise ValueError(
+                "serving.max_prefills_per_step must be >= 0, got "
+                f"{self.max_prefills}"
+            )
 
         # --- params (optionally int8 weight-quantized) ------------------
         self.quant_report = None
@@ -239,15 +279,30 @@ class ServingEngine:
         """Swap every ``page_table``/``seq_lens`` leaf (by NAME, at any
         depth — per-layer attention cursors and gpt2's position cursor
         alike) for host-built arrays of the target batch size."""
-        table = jnp.asarray(table, jnp.int32)
-        lens = jnp.asarray(lens, jnp.int32)
+        t = np.asarray(table)
+        if t.size and (int(t.min()) < 0 or int(t.max()) >= self.num_blocks):
+            # XLA clamps OOB gather/scatter indices SILENTLY — a corrupt
+            # table would read (and write) the wrong physical block. The
+            # host is the source of truth for tables, so range-check every
+            # injection; the traced guard in paged_decode_attention covers
+            # device-built tables under train.debug_checks.
+            raise ValueError(
+                f"page table entry out of range [0, {self.num_blocks}): "
+                f"min={int(t.min())} max={int(t.max())} — the XLA gather "
+                "would clamp this silently and corrupt another request's KV"
+            )
+        table = np.asarray(table, np.int32)
+        lens = np.asarray(lens, np.int32)
 
         def pick(path, leaf):
             name = getattr(path[-1], "key", None)
+            # A FRESH device buffer per leaf: the cache argument is
+            # donated, and XLA rejects donating one buffer twice — the
+            # per-layer cursor leaves must not alias.
             if name == "page_table":
-                return table
+                return jnp.asarray(np.array(table))
             if name == "seq_lens":
-                return lens
+                return jnp.asarray(np.array(lens))
             return leaf
 
         return jax.tree_util.tree_map_with_path(pick, cache)
@@ -287,16 +342,40 @@ class ServingEngine:
         tok, rng = self._sample_body(logits, rng, temp, tk, tp)
         return tok, rng, cache
 
-    def _compile(self, fn, *args, name: str | None = None):
+    def _compile(self, fn, *args, name: str | None = None,
+                 donate_argnums=()):
         self.num_compiles += 1
         t0 = time.perf_counter()
-        exe = jax.jit(fn).lower(*args).compile()
+        jitted = jax.jit(fn, donate_argnums=donate_argnums)
+        if donate_argnums:
+            # Donated builds bypass the persistent compilation cache: an
+            # executable with input->output aliasing that round-trips
+            # through cache serialization can come back with broken alias
+            # bookkeeping on this jax version — a cache-HIT donated
+            # prefill returned stale input bytes (the injected seq_lens)
+            # as its sampled token. The engine compiles each program once
+            # per process anyway, so the cache bought nothing here.
+            prev = jax.config.jax_enable_compilation_cache
+            jax.config.update("jax_enable_compilation_cache", False)
+            try:
+                exe = jitted.lower(*args).compile()
+            finally:
+                jax.config.update("jax_enable_compilation_cache", prev)
+        else:
+            exe = jitted.lower(*args).compile()
         if name is not None:
             # Device registry: compile wall time + memory_analysis(); a
             # second record under one name shows up as recompiles > 0 —
             # the zero-steady-state-recompile contract, visible as data.
+            # donated_args counts the donated INPUT LEAVES (the registry's
+            # donation counter): > 0 proves the cache pytree aliases
+            # input->output instead of double-buffering the KV pool.
             self._tel.record_exe(
-                name, exe, compile_s=time.perf_counter() - t0
+                name, exe, compile_s=time.perf_counter() - t0,
+                donated_args=sum(
+                    len(jax.tree_util.tree_leaves(args[i]))
+                    for i in donate_argnums
+                ),
             )
         return exe
 
@@ -313,6 +392,13 @@ class ServingEngine:
                 np.zeros((1, bucket), np.int32), np.zeros((1,), np.int32),
                 np.zeros((1, 2), np.uint32), np.zeros((1,), np.float32),
                 np.zeros((1,), np.int32), np.zeros((1,), np.float32),
+                # NOT donated, deliberately: XLA:CPU pairs the [1]-shaped
+                # token output with the donated [1]-shaped seq_lens leaf,
+                # and that aliasing intermittently returned stale input
+                # bytes as the sampled token (garbage/zero first tokens).
+                # Decode carries the donation win — it runs every step on
+                # the full pool; prefill runs once per request on a B=1
+                # slice, so double-buffering it is cheap and correct.
                 name=f"serving_prefill_{bucket}",
             )
             self._prefill_exe[bucket] = exe
@@ -328,6 +414,7 @@ class ServingEngine:
                 np.zeros((S,), np.float32), np.zeros((S,), np.int32),
                 np.zeros((S,), np.float32),
                 name="serving_decode",
+                donate_argnums=(1,),  # cache: pool buffers update in place
             )
         return self._decode_exe
 
@@ -435,7 +522,9 @@ class ServingEngine:
         with tel.span("schedule", step=self.step_count):
             admitted = (
                 [] if self.static_batching and self.scheduler.active
-                else self.scheduler.admit(now, self.bucket_of)
+                else self.scheduler.admit(
+                    now, self.bucket_of, max_admit=self.max_prefills
+                )
             )
         for state in admitted:
             self._event(
@@ -505,4 +594,6 @@ class ServingEngine:
             "calls": dict(self.calls),
             "steps": self.step_count,
             "quant": self.quant_report,
+            "attn_kernel": self.attn_kernel,
+            "max_prefills_per_step": self.max_prefills,
         }

@@ -206,15 +206,26 @@ class Scheduler:
         except ValueError:
             return -1
 
-    def admit(self, now: float, bucket_of) -> list[RequestState]:
+    def admit(self, now: float, bucket_of,
+              max_admit: int = 0) -> list[RequestState]:
         """FIFO-admit queued requests while a lane + blocks are available.
         ``bucket_of(prompt_len) -> P`` supplies the engine's prompt bucket
         (block reservation must cover the BUCKET: bulk prefill writes pad
         KV into the row's own pages — transformer.paged_decode_attention).
         Head-of-line blocking is deliberate: skipping ahead would starve
-        large requests under load."""
+        large requests under load.
+
+        ``max_admit`` (0 = unlimited) caps placements per call — the
+        engine's prefill/decode priority knob (serving.
+        max_prefills_per_step): every placement costs one prefill before
+        the running batch's next decode step, so a queue burst at high
+        occupancy would otherwise stall in-flight decodes behind
+        back-to-back prefills. Capped admissions stay FIFO; the remainder
+        is admitted on subsequent steps, interleaved between decodes."""
         placed = []
         while self.pending:
+            if max_admit and len(placed) >= max_admit:
+                break
             state = self.pending[0]
             req = state.request
             if req.deadline_s is not None and now > req.deadline_s:

@@ -85,6 +85,13 @@ def pytest_configure(config):
         "markers",
         "tpu_only: asserts real-MXU numerics; auto-skipped without a chip",
     )
+    # Pallas kernels exercised through the interpret-mode evaluator (the
+    # CPU parity lane). Selectable as `-m interpret` to smoke every kernel
+    # path quickly after a Mosaic/pallas version bump.
+    config.addinivalue_line(
+        "markers",
+        "interpret: Pallas kernel parity via the interpret-mode evaluator",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -326,3 +326,207 @@ def test_engine_rejects_prompt_beyond_largest_bucket():
     eng = _engine(model, params)
     with pytest.raises(ValueError, match="largest"):
         eng.submit(Request(prompt=list(range(1, 20)), max_new_tokens=2))
+
+
+# ---------------------------------------------------------------------------
+# Pallas paged-attention hot path (serving.attn_kernel='pallas')
+# ---------------------------------------------------------------------------
+
+# block_size must be a multiple of 8 for the pallas kernel (sublane tile);
+# everything else matches _CFG so the two modes schedule identically.
+_PALLAS_CFG = dataclasses.replace(_CFG, block_size=8, attn_kernel="pallas")
+
+
+@pytest.mark.interpret
+@pytest.mark.parametrize("name", ["gpt2", "llama"])
+def test_pallas_engine_greedy_matches_generate(name):
+    # The whole hot path under the kernel: bulk prefill (gather path,
+    # L>1), then every decode step reads the pool through the Pallas
+    # kernel (interpret mode on CPU) — tokens must equal generate()
+    # exactly, across mid-flight joins. Llama covers GQA (num_rep>1).
+    model, params = _model_and_params(name)
+    prompts = _prompts((5, 9, 3, 12))
+    padded, lens = pad_prompts(prompts, pad_id=0)
+    ref = np.asarray(generate(
+        model, params, padded, max_new_tokens=6, prompt_lens=lens
+    ))[:, -6:]
+    eng = _engine(model, params, _PALLAS_CFG)
+    assert eng.stats()["attn_kernel"] == "pallas"
+    for p in prompts:
+        eng.submit(Request(prompt=p, max_new_tokens=6))
+    done = eng.run()
+    assert len(done) == len(prompts)
+    for i, st in enumerate(done):
+        assert st.generated == list(ref[i]), f"request {i}"
+
+
+@pytest.mark.interpret
+def test_pallas_compile_count_pinned():
+    # Kernel selection must not disturb the AOT contract: one executable
+    # per bucket + one decode, and traffic never recompiles.
+    model, params = _model_and_params("gpt2")
+    eng = _engine(model, params, _PALLAS_CFG)
+    eng.warmup()
+    expected = len(_PALLAS_CFG.prompt_buckets) + 1
+    assert eng.num_compiles == expected
+    for plen, new in [(3, 2), (9, 4), (16, 1)]:
+        eng.submit(Request(prompt=_prompts((plen,))[0], max_new_tokens=new))
+    eng.run()
+    assert eng.num_compiles == expected
+
+
+# ---------------------------------------------------------------------------
+# Pool buffer donation (decode executable aliases the cache in place)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_donation_counter_in_registry(tmp_path):
+    from distributeddeeplearning_tpu.telemetry import Telemetry
+
+    tel = Telemetry(enabled=True, out_dir=str(tmp_path / "tel"))
+    model, params = _model_and_params("gpt2")
+    eng = _engine(model, params, telemetry=tel)
+    eng.warmup()
+    # The decode cache argument is donated: every pool/page-table/cursor
+    # leaf aliases input->output instead of double-buffering the KV pool.
+    dec = tel.registry.get("serving_decode")
+    assert dec is not None and dec["donated_args"] > 0
+    assert dec["donated_args"] == len(
+        jax.tree_util.tree_leaves(eng._cache)
+    )
+    # Prefill deliberately is NOT donated (XLA:CPU aliased its [1]-shaped
+    # token output with the donated seq_lens leaf and returned stale
+    # bytes) — the registry records that decision as data.
+    for b in _CFG.prompt_buckets:
+        pre = tel.registry.get(f"serving_prefill_{b}")
+        assert pre is not None and pre["donated_args"] == 0
+    # Donation must not break serving: run traffic through the engine.
+    st = eng.submit(Request(prompt=_prompts((5,))[0], max_new_tokens=4))
+    eng.run()
+    assert len(st.generated) == 4
+    assert dec["recompiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Page-table range safety (XLA gather clamps OOB indices silently)
+# ---------------------------------------------------------------------------
+
+
+def test_oob_host_page_table_fails_loudly():
+    model, params = _model_and_params("gpt2")
+    eng = _engine(model, params)
+    bad = np.zeros((eng.slots_n, eng.pages), np.int32)
+    bad[1, 2] = eng.num_blocks  # one past the pool end
+    with pytest.raises(ValueError, match="out of range"):
+        eng._inject(eng._cache, bad, np.zeros((eng.slots_n,), np.int32))
+    bad[1, 2] = -1
+    with pytest.raises(ValueError, match="out of range"):
+        eng._inject(eng._cache, bad, np.zeros((eng.slots_n,), np.int32))
+
+
+def test_debug_checks_poison_oob_rows_to_nan():
+    # Device-built tables bypass the host check; under train.debug_checks
+    # (jax_enable_checks) the traced guard in paged_decode_attention
+    # NaN-poisons exactly the rows whose table has an OOB entry.
+    from distributeddeeplearning_tpu.generate import decode_step
+
+    model, params = _model_and_params("gpt2")
+    kv_pages = (8, 4, 3)
+    pm = model.clone(decode=True, kv_pages=kv_pages)
+    tok = np.zeros((2, 1), np.int32)
+    shapes = jax.eval_shape(pm.init, jax.random.PRNGKey(0), tok)
+    import jax.numpy as jnp
+
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
+    )
+
+    def poison_tables(path, leaf):
+        if getattr(path[-1], "key", None) == "page_table":
+            t = np.zeros(leaf.shape, np.int32)
+            t[1, 0] = kv_pages[0] + 5  # row 1 corrupt, row 0 clean
+            return jnp.asarray(t)
+        return leaf
+
+    cache = jax.tree_util.tree_map_with_path(poison_tables, cache)
+    jax.config.update("jax_enable_checks", True)
+    try:
+        logits, _ = decode_step(pm, params, cache, tok)
+    finally:
+        jax.config.update("jax_enable_checks", False)
+    logits = np.asarray(logits)
+    assert np.isnan(logits[1]).all()  # poisoned, loudly
+    assert np.isfinite(logits[0]).all()  # clean row untouched
+
+
+# ---------------------------------------------------------------------------
+# Prefill/decode priority (serving.max_prefills_per_step)
+# ---------------------------------------------------------------------------
+
+
+def test_max_prefills_per_step_caps_admissions():
+    model, params = _model_and_params("gpt2")
+    cfg = dataclasses.replace(_CFG, slots=4, max_prefills_per_step=1)
+    eng = _engine(model, params, cfg)
+    states = [
+        eng.submit(Request(prompt=p, max_new_tokens=5))
+        for p in _prompts((4, 6, 3, 5))
+    ]
+    eng.run()
+    # every request still completes (no starvation under the cap) ...
+    assert all(len(st.generated) == 5 for st in states)
+    # ... but no engine step ever ran more than one prefill
+    per_step = {}
+    for e in eng.events:
+        if e["event"] == "request_admitted":
+            per_step[e["step"]] = per_step.get(e["step"], 0) + 1
+    assert per_step and max(per_step.values()) == 1
+    # the burst drained one admission per step, in order
+    assert sorted(per_step) == list(range(1, 5))
+
+
+def test_max_prefills_cap_does_not_change_tokens():
+    # Priority scheduling changes WHEN a request starts, never its tokens.
+    model, params = _model_and_params("gpt2")
+    prompts = _prompts((5, 7, 4))
+    outs = []
+    for cap in (0, 1):
+        cfg = dataclasses.replace(_CFG, max_prefills_per_step=cap)
+        eng = _engine(model, params, cfg)
+        states = [
+            eng.submit(Request(prompt=p, max_new_tokens=6))
+            for p in prompts
+        ]
+        eng.run()
+        outs.append([st.generated for st in states])
+    assert outs[0] == outs[1]
+
+
+# ---------------------------------------------------------------------------
+# New fences: attn_kernel and max_prefills_per_step
+# ---------------------------------------------------------------------------
+
+
+def test_fence_unknown_attn_kernel():
+    with pytest.raises(ValueError, match="attn_kernel"):
+        check_serving_composition(
+            _cfg(serving=ServingConfig(attn_kernel="cuda"))
+        )
+
+
+def test_fence_pallas_needs_sublane_aligned_blocks():
+    with pytest.raises(NotImplementedError, match="multiple of 8"):
+        check_serving_composition(_cfg(serving=ServingConfig(
+            attn_kernel="pallas", block_size=4,
+        )))
+    # aligned block sizes pass
+    check_serving_composition(_cfg(serving=ServingConfig(
+        attn_kernel="pallas", block_size=16,
+    )))
+
+
+def test_fence_negative_max_prefills():
+    with pytest.raises(ValueError, match="max_prefills_per_step"):
+        check_serving_composition(_cfg(serving=ServingConfig(
+            max_prefills_per_step=-1,
+        )))

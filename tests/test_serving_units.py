@@ -220,3 +220,52 @@ def test_metrics_record_shape():
     assert m["e2e_s"] == pytest.approx(1.7)
     assert m["inter_token_s"] == [pytest.approx(0.2)]
     assert m["new_tokens"] == 2 and not m["dropped"]
+
+
+# ---------------------------------------------------------------------------
+# Prefill/decode priority: the max_admit cap (serving.max_prefills_per_step)
+# ---------------------------------------------------------------------------
+
+
+def test_admit_cap_limits_placements_per_call():
+    s = _sched(slots=4)
+    ids = [s.submit(_req(), now=0.0).request.request_id for _ in range(5)]
+    placed = s.admit(1.0, _bucket_of, max_admit=2)
+    # capped AND still FIFO — the cap trims the tail, never reorders
+    assert [p.request.request_id for p in placed] == ids[:2]
+    assert len(s.pending) == 3
+
+
+def test_admit_cap_drains_across_calls_no_starvation():
+    s = _sched(slots=4)
+    ids = [s.submit(_req(), now=0.0).request.request_id for _ in range(4)]
+    seen = []
+    for step in range(1, 5):
+        seen += [p.request.request_id
+                 for p in s.admit(float(step), _bucket_of, max_admit=1)]
+        assert len(seen) == min(step, 4)  # exactly one per call until dry
+    assert seen == ids  # everyone admitted, in arrival order
+
+
+def test_admit_cap_zero_means_uncapped():
+    s = _sched(slots=4)
+    for _ in range(4):
+        s.submit(_req(), now=0.0)
+    assert len(s.admit(1.0, _bucket_of, max_admit=0)) == 4
+
+
+def test_admit_cap_does_not_break_reservation_guarantee():
+    # Capped admission must keep the all-or-nothing block reservation: a
+    # request admitted under the cap can never fail mid-flight for blocks.
+    s = _sched(slots=4, num_blocks=5, block_size=4)  # 4 usable blocks
+    for _ in range(3):
+        s.submit(_req(plen=4, max_new=4), now=0.0)  # 2 blocks each
+    (a,) = s.admit(1.0, _bucket_of, max_admit=1)
+    assert len(a.blocks) == 2 and s.pool.free_blocks == 2
+    (b,) = s.admit(2.0, _bucket_of, max_admit=1)  # second fits exactly
+    assert len(b.blocks) == 2 and s.pool.free_blocks == 0
+    assert s.admit(3.0, _bucket_of, max_admit=1) == []  # pool, not cap
+    blocks_a = list(a.blocks)
+    s.complete(a.slot, now=4.0)
+    (c,) = s.admit(4.0, _bucket_of, max_admit=1)
+    assert sorted(c.blocks) == sorted(blocks_a)  # freed blocks reused

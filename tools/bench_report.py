@@ -67,6 +67,15 @@ def _headline(rec: dict) -> dict:
         out["effective_dcn_bytes_per_sec"] = cal.get(
             "effective_dcn_bytes_per_sec"
         )
+    # Serving (BENCH_SERVING.json): the pinned relational claims are the
+    # headline — throughput and p99-TTFT vs the static baseline, plus the
+    # hot-path invariants (pallas row token-identical, decode donation).
+    comp = rec.get("comparison")
+    if isinstance(comp, dict):
+        for k in ("throughput_ratio", "p99_ttft_ratio",
+                  "pallas_tokens_match_reference", "decode_donation_live"):
+            if k in comp:
+                out[k] = comp[k]
     comps = rec.get("comparisons")
     if isinstance(comps, dict):
         reductions = [c["dcn_byte_reduction"] for c in comps.values()

@@ -20,12 +20,23 @@ The driver submits a request when the wall clock passes its arrival time
 and otherwise steps the engine; TTFT clocks from SUBMISSION (arrival),
 so queueing delay counts against both modes, as it does in production.
 
+A third row, ``continuous``/``pallas``, replays the same trace with
+``serving.attn_kernel='pallas'`` (ops/paged_attention.py — interpret
+mode on CPU, so the row measures scheduling with the kernel code path
+live, not kernel speed): same greedy trace, so its token stream must
+match the reference row's exactly (pinned in the comparison block).
+
 Per row: requests/s and generated tokens/s over the makespan (first
 arrival -> last completion), tokens/s/chip (this is a single-chip engine
 — chips=1; the multi-chip story is data-parallel engine replicas, see
 docs/SERVING.md), p50/p99 time-to-first-token, p50/p99 inter-token
-latency, block-pool high-water mark, and the compile counters proving
-steady state ran from the AOT executable cache (zero recompiles).
+latency, the per-PHASE host latency breakdown (p50/p99 of the engine's
+schedule/prefill/decode telemetry spans — where a step's wall time goes,
+which is what the max_prefills_per_step knob moves), block-pool
+high-water mark, the decode executable's donated-leaf count from the
+device registry (> 0 = the cache aliases input->output instead of
+double-buffering the pool), and the compile counters proving steady
+state ran from the AOT executable cache (zero recompiles).
 
 CPU-sim caveat (same as every BENCH_* artifact here): absolute rates are
 XLA:CPU numbers on a tiny model — meaningless as TPU predictions. The
@@ -107,13 +118,52 @@ def _percentiles(xs):
     }
 
 
-def _run_mode(model, params, trace, *, static: bool, quant: str = "none"):
+def _token_checksum(finished):
+    """CRC of every request's token stream, in request-id order — equal
+    checksums mean token-for-token identical output."""
+    import zlib
+
+    import numpy as np
+
+    toks = [t for s in finished for t in [-1] + s.generated]  # -1 delimits
+    return int(zlib.crc32(np.asarray(toks, np.int64).tobytes()))
+
+
+def _phase_latency_ms(tracer):
+    """p50/p99 of each engine phase's host wall time, from the telemetry
+    spans the engine wraps around schedule / prefill / decode."""
+    by_phase = {}
+    for s in tracer.spans:
+        by_phase.setdefault(s.name, []).append((s.t_end - s.t_start) * 1e3)
+    return {
+        phase: {
+            k: (None if v is None else round(v, 4))
+            for k, v in _percentiles(xs).items()
+        }
+        for phase, xs in sorted(by_phase.items())
+    }
+
+
+def _run_mode(model, params, trace, *, static: bool, quant: str = "none",
+              kernel: str = "reference"):
+    import tempfile
+
     from distributeddeeplearning_tpu.config import ServingConfig
     from distributeddeeplearning_tpu.serving import Request, ServingEngine
+    from distributeddeeplearning_tpu.telemetry import Telemetry
 
-    cfg = ServingConfig(**_SERVING_KW, quant=quant)
+    cfg = ServingConfig(**_SERVING_KW, quant=quant, attn_kernel=kernel)
+    # Enabled telemetry per row: the span ring is the source of the
+    # per-phase latency columns (sized for the whole run, not just the
+    # flight-recorder tail), and the registry carries the decode
+    # executable's donation counter.
+    tel = Telemetry(
+        enabled=True, out_dir=tempfile.mkdtemp(prefix="serve_bench_tel_"),
+        ring_size=1 << 17,
+    )
     engine = ServingEngine(
-        model, params, cfg, seed=_SEED, static_batching=static
+        model, params, cfg, seed=_SEED, static_batching=static,
+        telemetry=tel,
     )
     engine.warmup()  # compiles happen HERE, outside the timed window
     compiles_before = engine.num_compiles
@@ -143,9 +193,15 @@ def _run_mode(model, params, trace, *, static: bool, quant: str = "none"):
     ttfts = [m["ttft_s"] for m in per_req]
     itls = [x for m in per_req for x in m["inter_token_s"]]
     stats = engine.stats()
+    decode_reg = tel.registry.get("serving_decode") or {}
     return {
         "mode": "static" if static else "continuous",
+        "kernel": kernel,
         "quant": quant,
+        # Deterministic greedy trace: the pallas row must reproduce the
+        # reference row's tokens exactly — compared as a checksum so the
+        # artifact pins the claim without embedding ~1k tokens.
+        "token_checksum": _token_checksum(finished),
         "requests": len(per_req),
         "generated_tokens": gen_tokens,
         "makespan_s": round(makespan, 4),
@@ -159,6 +215,8 @@ def _run_mode(model, params, trace, *, static: bool, quant: str = "none"):
         "queue_s": _percentiles([m["queue_s"] for m in per_req]),
         "block_high_water": stats["block_high_water"],
         "num_blocks": stats["num_blocks"],
+        "phase_latency_ms": _phase_latency_ms(tel.tracer),
+        "decode_donated_args": int(decode_reg.get("donated_args", 0)),
         "compiles_warmup": compiles_before,
         "compiles_after_run": stats["num_compiles"],  # must equal warmup
         "decode_calls": stats["calls"]["decode"],
@@ -182,11 +240,12 @@ def main() -> int:
     rows = [
         _run_mode(model, params, trace, static=False),
         _run_mode(model, params, trace, static=True),
+        _run_mode(model, params, trace, static=False, kernel="pallas"),
     ]
     if _QUANT_ROW:
         rows.append(_run_mode(model, params, trace, static=False,
                               quant="int8"))
-    cont, stat = rows[0], rows[1]
+    cont, stat, pallas = rows[0], rows[1], rows[2]
     record = {
         "benchmark": "serving",
         "workload": {
@@ -212,6 +271,14 @@ def main() -> int:
             "zero_recompiles_in_steady_state": all(
                 r["compiles_after_run"] == r["compiles_warmup"]
                 for r in rows
+            ),
+            # The hot-path claims (PR 11): the pallas read path changes
+            # WHERE the pool is read from, never the tokens; and the
+            # decode executable aliases its cache in place.
+            "pallas_tokens_match_reference":
+                pallas["token_checksum"] == cont["token_checksum"],
+            "decode_donation_live": all(
+                r["decode_donated_args"] > 0 for r in rows
             ),
         },
     }
