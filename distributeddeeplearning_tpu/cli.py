@@ -586,11 +586,18 @@ def cmd_supervise(args) -> int:
     # (telemetry.py is stdlib-only).
     goodput_path = flight_dir = None
     if cfg.telemetry.enabled:
-        from .telemetry import resolve_dir
+        from .telemetry import resolve_dir, resolve_process_index, stamped
 
         flight_dir = resolve_dir(cfg)
         os.makedirs(flight_dir, exist_ok=True)
-        goodput_path = os.path.join(flight_dir, cfg.telemetry.goodput_file)
+        # Stamped per process (same resolution the child's Telemetry uses),
+        # so the supervisor's backoff records land in the SAME sidecar its
+        # child appends attempt records to — and N supervisors sharing one
+        # dir never interleave into each other's replay classification.
+        goodput_path = os.path.join(
+            flight_dir,
+            stamped(cfg.telemetry.goodput_file, resolve_process_index()),
+        )
     return supervise_command(
         cmd, cfg.supervisor, crash_clear_paths=clear,
         goodput_path=goodput_path, flight_dir=flight_dir,
@@ -598,37 +605,30 @@ def cmd_supervise(args) -> int:
 
 
 def cmd_report(tdir: str) -> int:
-    """Summarize a telemetry dir (``cli report --dir ...``): goodput
-    decomposition, trace validity/size, and the flight records present.
-    Pure stdlib — runs before ``init_distributed`` (no accelerator), so it
-    works on a quarantined artifact dir copied off the pod."""
-    import glob
-    import os
+    """Summarize a telemetry dir (``cli report --dir ...``): the FLEET
+    aggregation pass (``telemetry_aggregate.build_fleet``) over every
+    process's artifacts — merged Perfetto trace (written to
+    ``trace_merged.json``), pod goodput decomposition, straggler report,
+    merged latency histograms/gauges, and the flight records present;
+    the machine-readable form lands in ``<dir>/FLEET.json``. Accepts
+    both the stamped fleet layout and pre-fleet single-process dirs.
+    Pure stdlib — runs before ``init_distributed`` (no accelerator), so
+    it works on a quarantined artifact dir copied off the pod."""
+    from .telemetry_aggregate import build_fleet
 
-    from .telemetry import summarize_goodput, validate_chrome_trace
-
-    out: dict = {"dir": tdir}
-    out["goodput"] = summarize_goodput(os.path.join(tdir, "goodput.jsonl"))
-    trace_path = os.path.join(tdir, "trace.json")
-    if os.path.exists(trace_path):
-        try:
-            with open(trace_path) as f:
-                trace = json.load(f)
-            problems = validate_chrome_trace(trace)
-        except (OSError, ValueError):
-            trace, problems = {}, ["unreadable trace.json"]
-        out["trace"] = {
-            "path": trace_path,
-            "events": len(trace.get("traceEvents", ())),
-            "valid": not problems,
-            "problems": problems,
-        }
-    else:
-        out["trace"] = None
-    out["flights"] = sorted(
-        os.path.basename(p)
-        for p in glob.glob(os.path.join(tdir, "flight_*.json"))
-    )
+    fleet = build_fleet(tdir)
+    out: dict = {
+        "dir": tdir,
+        "goodput": fleet["goodput"],
+        "trace": fleet["trace"] if fleet["trace"]["events"] else None,
+        "flights": [f["file"] for f in fleet["flights"]],
+        "straggler": fleet["straggler"],
+        "histograms": fleet["histograms"],
+        "gauges": fleet["gauges"],
+        "processes": fleet["processes"],
+        "headline": fleet["headline"],
+        "fleet_json": "FLEET.json",
+    }
     print(json.dumps(out, indent=2))
     return 0
 
@@ -643,7 +643,8 @@ def _free_port() -> int:
 
 def _launch_plan(config: str, overrides: list[str], num_processes: int,
                  *, devices_per_process: int = 0, coordinator_port: int = 0,
-                 xla_perf_flags: bool = False, base_env: dict | None = None):
+                 xla_perf_flags: bool = False, base_env: dict | None = None,
+                 independent: bool = False):
     """``[(cmd, env), ...]`` for every child of ``cli launch`` — pure
     (no processes spawned), so tests can pin the plan.
 
@@ -655,7 +656,18 @@ def _launch_plan(config: str, overrides: list[str], num_processes: int,
     SIMULATED CPU devices per child (utils.compat.set_cpu_device_env) — the
     multiprocess CPU backend used for multi-slice rehearsal
     (docs/MULTISLICE.md); 0 leaves device discovery to the runtime (real
-    TPU hosts)."""
+    TPU hosts).
+
+    Every child gets ``DDL_PROCESS_INDEX`` — the telemetry layer's fleet
+    stamp (``telemetry.resolve_process_index``), so N children sharing one
+    ``--telemetry`` dir write non-clobbering per-process artifacts.
+    ``independent=True`` skips the coordinator rendezvous entirely: the
+    children run as N UNCOORDINATED single-process workers (each with its
+    own device view). That is the fleet-observability rehearsal mode — the
+    shared-telemetry-dir shape of a pod launch on a machine whose CPU
+    backend cannot rendezvous (multiprocess CPU needs jax >= 0.5,
+    docs/MULTISLICE.md) — and the N-replica serving shape of ROADMAP
+    item 1."""
     import os
 
     if num_processes < 2:
@@ -663,7 +675,7 @@ def _launch_plan(config: str, overrides: list[str], num_processes: int,
             f"--num-processes={num_processes}: a multiprocess launch needs "
             ">= 2 (single-process runs don't need the launcher)"
         )
-    port = coordinator_port or _free_port()
+    port = None if independent else (coordinator_port or _free_port())
     cmd = [
         sys.executable, "-m", "distributeddeeplearning_tpu.cli",
         "train", "--config", config,
@@ -675,9 +687,16 @@ def _launch_plan(config: str, overrides: list[str], num_processes: int,
     plan = []
     for pid in range(num_processes):
         env = dict(os.environ if base_env is None else base_env)
-        env["COORDINATOR_ADDRESS"] = f"localhost:{port}"
-        env["NUM_PROCESSES"] = str(num_processes)
-        env["PROCESS_ID"] = str(pid)
+        if not independent:
+            env["COORDINATOR_ADDRESS"] = f"localhost:{port}"
+            env["NUM_PROCESSES"] = str(num_processes)
+            env["PROCESS_ID"] = str(pid)
+        else:
+            # A previous coordinated run's env must not leak into the
+            # children: they are single-process by construction.
+            for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+                env.pop(k, None)
+        env["DDL_PROCESS_INDEX"] = str(pid)
         if devices_per_process > 0:
             from .utils.compat import set_cpu_device_env
 
@@ -711,6 +730,7 @@ def cmd_launch(args) -> int:
         devices_per_process=args.devices_per_process,
         coordinator_port=args.coordinator_port,
         xla_perf_flags=args.xla_perf_flags,
+        independent=args.independent,
     )
     procs, threads = [], []
     for pid, (cmd, env) in enumerate(plan):
@@ -799,6 +819,13 @@ def main(argv=None) -> int:
                 "--coordinator-port", type=int, default=0,
                 help="jax.distributed coordinator port (0 = pick a free "
                 "one)",
+            )
+            p.add_argument(
+                "--independent", action="store_true",
+                help="skip the coordinator rendezvous: run the N workers "
+                "as independent single-process jobs sharing one "
+                "--telemetry dir (fleet-observability rehearsal; "
+                "docs/OBSERVABILITY.md)",
             )
     pr = sub.add_parser("report")
     pr.add_argument(

@@ -30,13 +30,20 @@ SERVING_EVENTS = ("request_admitted", "first_token", "request_completed")
 def serving_event(name: str, step: int, *, request_id: int, **fields) -> dict:
     """A serving lifecycle event as a metrics-stream record. ``name`` must
     be one of :data:`SERVING_EVENTS`; every record carries the request id
-    so per-request traces can be reassembled from the flat stream."""
+    so per-request traces can be reassembled from the flat stream.
+
+    The id here is the SAME value the engine puts in its span args
+    (``prefill``'s ``request_id``, ``schedule``/``decode``'s
+    ``request_ids``), so one request's lifecycle is joinable end-to-end
+    across the event stream and the (fleet-merged) Perfetto trace — which
+    is why it is coerced to a plain int: a numpy scalar would render as a
+    different JSON token in one stream than the other."""
     if name not in SERVING_EVENTS:
         raise ValueError(
             f"unknown serving event {name!r} (expected one of "
             f"{SERVING_EVENTS})"
         )
-    return event_record(name, step, request_id=request_id, **fields)
+    return event_record(name, step, request_id=int(request_id), **fields)
 
 
 def serving_gauges(step: int, *, pending: int, active: int, free_blocks: int,

@@ -511,6 +511,10 @@ class ServingEngine:
             "first_token", state, slot=slot,
             ttft_s=round(now - state.arrival_s, 6),
         )
+        # SLO feed: TTFT (arrival -> first token, queueing included) into
+        # the mergeable fleet histogram (telemetry.LatencyHistogram) —
+        # what serve_bench and the FLEET.json report read percentiles from.
+        self._tel.hist("ttft").record(now - state.arrival_s)
         self._finish_if_done(state, tok)
 
     def step(self) -> bool:
@@ -519,19 +523,26 @@ class ServingEngine:
         self.step_count += 1
         tel = self._tel
         now = self.clock()
-        with tel.span("schedule", step=self.step_count):
+        with tel.span("schedule", step=self.step_count) as sp:
             admitted = (
                 [] if self.static_batching and self.scheduler.active
                 else self.scheduler.admit(
                     now, self.bucket_of, max_admit=self.max_prefills
                 )
             )
+            if admitted:
+                # Request ids discovered inside the span land on its B
+                # event (set()), so one request's admission -> prefill ->
+                # decode lifecycle is traceable end-to-end in the merged
+                # Perfetto view.
+                sp.set(request_ids=[s.request.request_id for s in admitted])
         for state in admitted:
             self._event(
                 "request_admitted", state, slot=state.slot,
                 bucket=state.bucket, blocks=len(state.blocks),
                 queue_s=round(now - state.arrival_s, 6),
             )
+            tel.hist("queue_wait").record(now - state.arrival_s)
             with tel.span(
                 "prefill", step=self.step_count,
                 request_id=state.request.request_id, bucket=state.bucket,
@@ -541,16 +552,25 @@ class ServingEngine:
             # Engine-level gauges at a configurable cadence: queue depth
             # and pool occupancy are the capacity-tuning signals
             # (docs/OBSERVABILITY.md), too noisy to emit per request.
-            rec = serving_gauges(self.step_count, **self.scheduler.gauges())
+            gauges = self.scheduler.gauges()
+            rec = serving_gauges(self.step_count, **gauges)
             self._emit(rec)
             tel.note_event(rec)
+            # Gauge digest (last + running max) for the fleet report —
+            # queue depth / free blocks are the saturation signals the
+            # replica router sheds on.
+            tel.note_gauges(gauges)
         active = self.scheduler.active
         if not active:
             return not self.scheduler.idle
         cacheS = self._inject(self._cache, self._table, self._lens)
-        with tel.span(
-            "decode", step=self.step_count, batch=len(active)
-        ):
+        decode_args = {"step": self.step_count, "batch": len(active)}
+        if tel.enabled:
+            # Only materialize the id list when a tracer will keep it.
+            decode_args["request_ids"] = [
+                s.request.request_id for s in active
+            ]
+        with tel.span("decode", **decode_args):
             tok, rng, cacheS = self._decode_exe_or_compile()(
                 self._params, cacheS, self._tok[:, None], self._rng,
                 self._temp, self._top_k, self._top_p,

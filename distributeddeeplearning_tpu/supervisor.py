@@ -327,16 +327,23 @@ class Supervisor:
                         # The killed/crashed child may not have written its
                         # own flight record — preserve what the supervisor
                         # knows (last heartbeat = last reported location).
-                        from .telemetry import dump_flight
+                        # Fleet-stamped like every other artifact so N
+                        # supervisors can share one dir.
+                        from .telemetry import (
+                            dump_flight,
+                            resolve_process_index,
+                        )
 
+                        pidx = resolve_process_index()
                         dump_flight(
                             os.path.join(
                                 self._flight_dir,
-                                f"flight_supervisor_{kind}_attempt"
+                                f"flight_supervisor_{kind}_p{pidx}_attempt"
                                 f"{restarts}.json",
                             ),
                             reason=f"supervisor_{kind}",
                             attempt=restarts,
+                            process_index=pidx,
                             returncode=rc,
                             heartbeat=hb or None,
                             phase=hb.get("phase"),

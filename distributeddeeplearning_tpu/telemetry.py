@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import tempfile
 import time
@@ -65,6 +66,174 @@ GOODPUT_CATEGORIES = (
     "productive_step", "rollback_replay", "compile", "data_wait",
     "checkpoint_stall", "eval", "restart_backoff", "other",
 )
+
+
+def stamped(base: str, process_index: int, attempt: int | None = None) -> str:
+    """Per-process (and optionally per-attempt) artifact name:
+    ``trace.json`` -> ``trace_p3_a1.json``. N ``cli launch`` children can
+    then share one telemetry dir without clobbering each other, and the
+    fleet aggregator (``telemetry_aggregate.py``) can attribute every
+    artifact back to its (process, attempt)."""
+    root, ext = os.path.splitext(base)
+    name = f"{root}_p{int(process_index)}"
+    if attempt is not None:
+        name += f"_a{int(attempt)}"
+    return name + ext
+
+
+# ---------------------------------------------------------------------------
+# streaming latency histogram
+# ---------------------------------------------------------------------------
+
+
+class LatencyHistogram:
+    """Fixed-size log-bucketed streaming histogram over seconds.
+
+    The SLO-grade percentile sketch: ``n`` buckets geometrically spaced
+    over ``[lo, hi)`` (out-of-range samples clamp into the edge buckets),
+    so memory is O(n) regardless of sample count — unlike the
+    store-every-sample ``np.percentile`` math it replaces in
+    ``tools/serve_bench.py``. Two invariants the tests pin:
+
+    - **exact count**: ``sum(counts) == count`` always — a recorded
+      sample is never lost to rounding;
+    - **merge == union**: merging two histograms (same layout) is
+      elementwise count addition, so a fleet-level histogram merged from
+      N processes equals the histogram of the concatenated samples —
+      percentiles aggregate across processes without shipping samples.
+
+    ``percentile(q)`` returns the geometric midpoint of the bucket
+    holding the ceil-rank order statistic, clamped to the observed
+    min/max — within one bucket's relative width (:attr:`rel_error`,
+    ~8.4% at the default layout) of the exact order statistic for any
+    in-range sample."""
+
+    __slots__ = ("lo", "hi", "n", "_log_lo", "_log_g", "counts", "count",
+                 "sum", "min", "max")
+
+    def __init__(self, lo: float = 1e-6, hi: float = 1000.0, n: int = 256):
+        if not (0.0 < lo < hi) or n < 2:
+            raise ValueError(f"bad histogram layout lo={lo} hi={hi} n={n}")
+        self.lo, self.hi, self.n = float(lo), float(hi), int(n)
+        self._log_lo = math.log(self.lo)
+        self._log_g = (math.log(self.hi) - self._log_lo) / self.n
+        self.counts = [0] * self.n
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+    @property
+    def rel_error(self) -> float:
+        """One bucket's relative width (the percentile error bound)."""
+        return math.exp(self._log_g) - 1.0
+
+    def layout(self) -> tuple[float, float, int]:
+        return (self.lo, self.hi, self.n)
+
+    def record(self, seconds: float) -> None:
+        x = float(seconds)
+        self.count += 1
+        self.sum += x
+        if self.min is None or x < self.min:
+            self.min = x
+        if self.max is None or x > self.max:
+            self.max = x
+        if x < self.lo:
+            i = 0
+        elif x >= self.hi:
+            i = self.n - 1
+        else:
+            i = min(int((math.log(x) - self._log_lo) / self._log_g),
+                    self.n - 1)
+        self.counts[i] += 1
+
+    def percentile(self, q: float) -> float | None:
+        """The q-th percentile (0..100) as the geometric midpoint of the
+        bucket containing the ceil-rank order statistic; None when
+        empty."""
+        if not self.count:
+            return None
+        rank = max(1, math.ceil(q / 100.0 * self.count))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank:
+                mid = math.exp(self._log_lo + (i + 0.5) * self._log_g)
+                return min(max(mid, self.min), self.max)
+        return self.max  # unreachable while the exact-count invariant holds
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """In-place merge (identical layouts only). Merge-equals-union is
+        what makes per-process histograms a fleet primitive."""
+        if self.layout() != other.layout():
+            raise ValueError(
+                f"histogram layout mismatch: {self.layout()} vs "
+                f"{other.layout()} — merge requires identical buckets"
+            )
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        if other.min is not None and (self.min is None or other.min < self.min):
+            self.min = other.min
+        if other.max is not None and (self.max is None or other.max > self.max):
+            self.max = other.max
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": 1,
+            "lo": self.lo,
+            "hi": self.hi,
+            "n": self.n,
+            "count": self.count,
+            "sum": round(self.sum, 9),
+            "min": self.min,
+            "max": self.max,
+            # Sparse encoding: most of the 256 buckets are empty.
+            "buckets": {str(i): c for i, c in enumerate(self.counts) if c},
+        }
+
+    @classmethod
+    def from_dict(cls, rec: dict) -> "LatencyHistogram":
+        h = cls(lo=rec["lo"], hi=rec["hi"], n=rec["n"])
+        for i, c in (rec.get("buckets") or {}).items():
+            h.counts[int(i)] = int(c)
+        h.count = int(rec.get("count", sum(h.counts)))
+        h.sum = float(rec.get("sum", 0.0))
+        h.min = rec.get("min")
+        h.max = rec.get("max")
+        return h
+
+    def summary(self) -> dict:
+        """The report-facing digest (FLEET.json / BENCH_SERVING.json)."""
+        return {
+            "count": self.count,
+            "p50_s": _round6(self.percentile(50)),
+            "p99_s": _round6(self.percentile(99)),
+            "mean_s": _round6(self.sum / self.count) if self.count else None,
+            "min_s": _round6(self.min),
+            "max_s": _round6(self.max),
+            "rel_error": round(self.rel_error, 6),
+        }
+
+
+def _round6(v):
+    return None if v is None else round(v, 6)
+
+
+class _NullHistogram:
+    """Disabled-telemetry histogram: one shared instance, records nothing."""
+
+    __slots__ = ()
+    count = 0
+
+    def record(self, seconds: float) -> None:
+        pass
+
+
+NULL_HISTOGRAM = _NullHistogram()
 
 
 # ---------------------------------------------------------------------------
@@ -93,6 +262,9 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
+    def set(self, **args) -> None:
+        pass
+
 
 NULL_SPAN = _NullSpan()
 
@@ -111,13 +283,20 @@ class _SpanCM:
         self._start = tr._now()
         return self
 
+    def set(self, **args) -> None:
+        """Attach args discovered INSIDE the span (e.g. the request ids a
+        ``schedule`` span admitted) — they land on the span's B event."""
+        self._args.update(args)
+
     def __exit__(self, *exc):
         tr = self._tracer
         end = tr._now()
         tr._stack.pop()
-        tr._ring.append(
-            Span(self._name, self._start, end, len(tr._stack), self._args)
-        )
+        span = Span(self._name, self._start, end, len(tr._stack), self._args)
+        tr._ring.append(span)
+        cb = tr.on_close
+        if cb is not None:
+            cb(span)
         return False
 
 
@@ -145,6 +324,10 @@ class SpanTracer:
         self._ring: deque[Span] = deque(maxlen=int(ring_size))
         self._stack: list[str] = []
         self._last = 0.0
+        # Optional callable(Span) fired at every span close — how the
+        # Telemetry bundle feeds per-phase latency histograms without the
+        # instrumented code changing (still one attribute check when unset).
+        self.on_close = None
 
     def _now(self) -> float:
         t = self._clock()
@@ -171,7 +354,10 @@ class SpanTracer:
         """Chrome-trace/Perfetto JSON: one B and one E event per completed
         span, microsecond timestamps relative to the oldest ringed span,
         strictly increasing (rounding collisions are bumped by 1us so the
-        stream stays well-formed after integer truncation)."""
+        stream stays well-formed after integer truncation). Top-level
+        ``t0_s`` is the tracer-clock zero of the ts axis — what the fleet
+        aggregator pairs with the process's wall-clock anchor record to
+        place N hosts' traces on one timeline."""
         events = []
         for s in self._ring:
             events.append((s.t_start, "B", s))
@@ -191,7 +377,8 @@ class SpanTracer:
             if ph == "B" and s.args:
                 ev["args"] = dict(s.args)
             out.append(ev)
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        return {"traceEvents": out, "displayTimeUnit": "ms",
+                "t0_s": round(t0, 9)}
 
     def write_chrome_trace(self, path: str) -> str | None:
         return _write_json(path, self.chrome_trace())
@@ -229,22 +416,33 @@ class SpanTracer:
 def validate_chrome_trace(trace) -> list[str]:
     """Structural validation of a Chrome-trace dict: returns a list of
     problems (empty == valid). Checks: traceEvents list, non-decreasing
-    timestamps, and that B/E events pair up under stack discipline."""
+    timestamps, and that B/E events pair up under stack discipline —
+    per ``(pid, tid)`` track, so a fleet-merged trace (one pid per
+    process, interleaved timestamps) validates exactly like a
+    single-process one. ``M`` metadata events (process/thread names) are
+    structural no-ops."""
     problems: list[str] = []
     if not isinstance(trace, dict) or not isinstance(
         trace.get("traceEvents"), list
     ):
         return ["no traceEvents list"]
     prev_ts = None
-    stack: list[str] = []
+    stacks: dict[tuple, list[str]] = {}
     for i, ev in enumerate(trace["traceEvents"]):
-        if not isinstance(ev, dict) or "ph" not in ev or "ts" not in ev:
+        if not isinstance(ev, dict) or "ph" not in ev:
+            problems.append(f"event {i}: missing ph/ts")
+            continue
+        if ev["ph"] == "M":
+            continue  # metadata carries no duration semantics
+        if "ts" not in ev:
             problems.append(f"event {i}: missing ph/ts")
             continue
         ts = ev["ts"]
         if prev_ts is not None and ts < prev_ts:
             problems.append(f"event {i}: ts {ts} < previous {prev_ts}")
         prev_ts = ts
+        track = (ev.get("pid"), ev.get("tid"))
+        stack = stacks.setdefault(track, [])
         if ev["ph"] == "B":
             stack.append(ev.get("name", ""))
         elif ev["ph"] == "E":
@@ -258,8 +456,9 @@ def validate_chrome_trace(trace) -> list[str]:
                 stack.pop()
             else:
                 stack.pop()
-    if stack:
-        problems.append(f"unclosed spans at end: {stack}")
+    for track, stack in sorted(stacks.items(), key=lambda kv: str(kv[0])):
+        if stack:
+            problems.append(f"unclosed spans at end: {stack} (track {track})")
     return problems
 
 
@@ -526,6 +725,22 @@ def dump_flight(path: str, *, reason: str, tracer: SpanTracer | None = None,
 # ---------------------------------------------------------------------------
 
 
+def resolve_process_index(env=None) -> int:
+    """This process's fleet index, from the environment (stdlib-only —
+    no jax import): ``DDL_PROCESS_INDEX`` (exported by ``cli launch`` for
+    every child, both coordinated and ``--independent``) wins, then the
+    coordinated-mode ``PROCESS_ID`` the launcher already threads to
+    ``mesh.init_distributed``, else 0 (single process)."""
+    env = os.environ if env is None else env
+    for var in ("DDL_PROCESS_INDEX", "PROCESS_ID"):
+        v = env.get(var, "")
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            continue
+    return 0
+
+
 def resolve_dir(cfg) -> str:
     """The telemetry output dir for a full ``Config``: explicit
     ``telemetry.dir`` wins; else quarantine-adjacent inside
@@ -550,14 +765,21 @@ class Telemetry:
     check per hook.
     """
 
+    # Span names whose durations auto-feed a same-named latency histogram
+    # (via the tracer's on_close hook): the per-phase SLO distributions.
+    HIST_SPANS = frozenset(SPAN_NAMES)
+
     def __init__(self, *, enabled: bool = True, out_dir: str | None = None,
-                 attempt: int = 0, ring_size: int = 4096,
+                 attempt: int = 0, process_index: int = 0,
+                 ring_size: int = 4096,
                  flight_last: int = 256, trace_file: str = "trace.json",
                  goodput_file: str = "goodput.jsonl",
-                 span_clock=time.perf_counter, wall_clock=time.monotonic):
+                 span_clock=time.perf_counter, wall_clock=time.monotonic,
+                 epoch_clock=time.time):
         self.enabled = bool(enabled) and out_dir is not None
         self.dir = out_dir
         self.attempt = int(attempt)
+        self.process_index = int(process_index)
         self.flight_last = int(flight_last)
         self._trace_file = trace_file
         self.tracer = SpanTracer(
@@ -566,6 +788,10 @@ class Telemetry:
         self.registry = DeviceRegistry()
         self.events: deque = deque(maxlen=int(flight_last))
         self.ledger = None
+        self.hists: dict[str, LatencyHistogram] = {}
+        self._gauge_last: dict = {}
+        self._gauge_max: dict = {}
+        self._gauge_samples = 0
         if self.enabled:
             try:
                 os.makedirs(out_dir, exist_ok=True)
@@ -573,21 +799,45 @@ class Telemetry:
                 self.enabled = False
                 self.tracer.enabled = False
                 return
+            self.tracer.on_close = self._on_span_close
             self.ledger = GoodputLedger(
-                os.path.join(out_dir, goodput_file),
+                os.path.join(out_dir, stamped(goodput_file, process_index)),
                 attempt=attempt, clock=wall_clock,
             )
+            # Clock-alignment anchor, written EAGERLY at open (crash-safe):
+            # one simultaneous (wall epoch, span clock) reading pairs this
+            # process's private monotonic ts axis with shared wall time, so
+            # the aggregator can place N hosts' traces on one timeline.
+            _write_json(self.anchor_path, {
+                "schema": 1,
+                "record": "anchor",
+                "process_index": self.process_index,
+                "attempt": self.attempt,
+                "pid": os.getpid(),
+                "wall_epoch_s": float(epoch_clock()),
+                "span_clock_s": float(self.tracer._clock()),
+            })
 
     @classmethod
-    def from_config(cls, cfg, *, attempt: int = 0) -> "Telemetry":
-        """Build from a full ``Config`` (NULL when telemetry is off)."""
+    def from_config(cls, cfg, *, attempt: int = 0,
+                    process_index: int | None = None) -> "Telemetry":
+        """Build from a full ``Config`` (NULL when telemetry is off).
+
+        ``process_index=None`` resolves from the environment —
+        ``DDL_PROCESS_INDEX`` (set by ``cli launch`` for every child) or
+        the coordinated-mode ``PROCESS_ID`` — so N children sharing one
+        telemetry dir stamp their artifacts without the caller having to
+        thread an index through (single process ⇒ 0)."""
         t = cfg.telemetry
         if not t.enabled:
             return NULL_TELEMETRY
+        if process_index is None:
+            process_index = resolve_process_index()
         return cls(
             enabled=True,
             out_dir=resolve_dir(cfg),
             attempt=attempt,
+            process_index=process_index,
             ring_size=t.ring_size,
             flight_last=t.flight_last,
             trace_file=t.trace_file,
@@ -600,6 +850,36 @@ class Telemetry:
         if not self.enabled:
             return NULL_SPAN
         return self.tracer.span(name, **args)
+
+    def _on_span_close(self, span: Span) -> None:
+        if span.name in self.HIST_SPANS:
+            self.hist(span.name).record(span.t_end - span.t_start)
+
+    def hist(self, name: str):
+        """The named latency histogram (created on first use, fixed
+        default layout so every process's histograms merge). Spans named
+        in :attr:`HIST_SPANS` feed these automatically; callers record
+        derived latencies (``ttft``, queueing delay, ...) explicitly."""
+        if not self.enabled:
+            return NULL_HISTOGRAM
+        h = self.hists.get(name)
+        if h is None:
+            h = self.hists[name] = LatencyHistogram()
+        return h
+
+    def note_gauges(self, gauges: dict) -> None:
+        """Record one gauge sample (queue depth, free KV blocks, ...):
+        last value + running max per key — the saturation signals the
+        fleet report surfaces without storing the time series."""
+        if not self.enabled:
+            return
+        self._gauge_samples += 1
+        for k, v in gauges.items():
+            self._gauge_last[k] = v
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                prev = self._gauge_max.get(k)
+                if prev is None or v > prev:
+                    self._gauge_max[k] = v
 
     def note_event(self, record: dict) -> None:
         """Mirror one emit-stream record into the flight-recorder ring."""
@@ -644,29 +924,68 @@ class Telemetry:
         if not self.enabled:
             return None
         path = os.path.join(
-            self.dir, f"flight_{reason}_attempt{self.attempt}.json"
+            self.dir,
+            f"flight_{reason}_p{self.process_index}"
+            f"_attempt{self.attempt}.json",
         )
         return dump_flight(
             path, reason=reason, tracer=self.tracer, events=self.events,
-            last=self.flight_last, attempt=self.attempt, **extra,
+            last=self.flight_last, attempt=self.attempt,
+            process_index=self.process_index, **extra,
         )
 
+    def stats_dict(self) -> dict:
+        """The mergeable per-process stats record: every latency histogram
+        (full bucket encoding — the aggregator re-materializes and merges
+        them), the gauge digest, and the executable registry."""
+        return {
+            "schema": 1,
+            "record": "stats",
+            "process_index": self.process_index,
+            "attempt": self.attempt,
+            "histograms": {k: h.to_dict()
+                           for k, h in sorted(self.hists.items())},
+            "gauges": {
+                "samples": self._gauge_samples,
+                "last": dict(self._gauge_last),
+                "max": dict(self._gauge_max),
+            },
+            "registry": self.registry.to_dict(),
+        }
+
     def write_trace(self) -> str | None:
-        """Write (atomically replace) the Chrome trace + span JSONL from
-        the current ring. Idempotent; called at every attempt boundary so
-        the newest trace survives whatever happens next."""
+        """Write (atomically replace) the Chrome trace + span JSONL + the
+        histogram/gauge/registry stats record from the current state.
+        Idempotent; called at every attempt boundary so the newest
+        artifacts survive whatever happens next."""
         if not self.enabled:
             return None
-        self.tracer.write_jsonl(os.path.join(self.dir, "spans.jsonl"))
-        return self.tracer.write_chrome_trace(
-            os.path.join(self.dir, self._trace_file)
+        self.tracer.write_jsonl(self.spans_path)
+        _write_json(self.stats_path, self.stats_dict())
+        return self.tracer.write_chrome_trace(self.trace_path)
+
+    def _stamped_path(self, base: str) -> str | None:
+        if not self.enabled:
+            return None
+        return os.path.join(
+            self.dir, stamped(base, self.process_index, self.attempt)
         )
 
     @property
     def trace_path(self) -> str | None:
-        if not self.enabled:
-            return None
-        return os.path.join(self.dir, self._trace_file)
+        return self._stamped_path(self._trace_file)
+
+    @property
+    def spans_path(self) -> str | None:
+        return self._stamped_path("spans.jsonl")
+
+    @property
+    def stats_path(self) -> str | None:
+        return self._stamped_path("stats.json")
+
+    @property
+    def anchor_path(self) -> str | None:
+        return self._stamped_path("anchor.json")
 
 
 NULL_TELEMETRY = Telemetry(enabled=False, out_dir=None)

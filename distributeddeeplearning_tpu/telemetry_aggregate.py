@@ -1,0 +1,508 @@
+"""Fleet telemetry aggregation: N processes' artifacts -> one view.
+
+PR 10's telemetry is strictly per-process; ``cli launch`` makes the
+system multi-process. This module (stdlib-only, like ``telemetry.py`` —
+the supervisor and report tooling import it without touching jax) folds
+one shared ``--telemetry`` dir full of per-process artifacts into the
+fleet signals ROADMAP items 1-2 consume:
+
+- :func:`merge_traces` — N Chrome traces on ONE timeline (pid = process
+  index, tid = attempt), each process's private monotonic ``ts`` axis
+  aligned to shared wall time via the ``anchor_p{P}_a{A}.json`` record
+  every :class:`telemetry.Telemetry` writes at open (a simultaneous
+  (wall-epoch, span-clock) reading). The merged trace passes
+  ``validate_chrome_trace`` by construction: per-process streams are
+  well-formed, the merge sorts globally by timestamp while preserving
+  each track's internal order, and validation is per-(pid, tid).
+- :func:`aggregate_goodput` — N goodput sidecars -> one pod summary
+  whose categories still sum exactly to the aggregate wall clock (each
+  attempt record is exact by construction; summing exact records is
+  exact up to the 6-decimal rounding the ledger already commits to,
+  and the residual is folded into ``other`` and reported, never hidden).
+- :func:`straggler_report` — per-step cross-host skew from the aligned
+  ``step`` spans: skew p50/p99/max, the slowest host, and
+  persistent-offender detection over a trailing window (the
+  MLPerf-pod-paper failure mode: one host late every step).
+- :func:`merge_stats` — per-process latency histograms merged
+  bucket-wise (merge == histogram-of-union, pinned by tests) plus the
+  queue-depth/free-block gauge digest.
+- :func:`build_fleet` — runs all of the above and writes
+  ``trace_merged.json`` + ``FLEET.json`` (schema in
+  docs/OBSERVABILITY.md); what ``cli report`` and
+  ``tools/telemetry_report.py --check`` call.
+
+Clock-alignment caveat (docs/OBSERVABILITY.md): anchors use each host's
+``time.time()``, so cross-host placement is only as good as NTP sync —
+fine for straggler detection at step granularity (ms-scale skew >> µs
+NTP error), not for ordering individual µs-scale events across hosts.
+Within one host, alignment is exact.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import time
+
+from .telemetry import (
+    LatencyHistogram,
+    read_goodput,
+    validate_chrome_trace,
+)
+
+FLEET_SCHEMA_VERSION = 1
+
+# Persistent-offender policy: slowest in >= OFFENDER_FRAC of the last
+# OFFENDER_WINDOW common steps.
+OFFENDER_WINDOW = 32
+OFFENDER_FRAC = 0.75
+
+_STAMP_RE = re.compile(
+    r"^(?P<root>anchor|trace|spans|stats|goodput)"
+    r"_p(?P<p>\d+)(?:_a(?P<a>\d+))?\.(?:json|jsonl)$"
+)
+_FLIGHT_RE = re.compile(
+    r"^flight_(?P<reason>.+?)(?:_p(?P<p>\d+))?_attempt(?P<a>\d+)\.json$"
+)
+# Pre-fleet (PR 10) unstamped artifacts map to process 0.
+_LEGACY = {
+    "trace.json": ("trace", 0, 0),
+    "spans.jsonl": ("spans", 0, 0),
+    "goodput.jsonl": ("goodput", 0, None),
+}
+
+
+def discover(dir_path: str) -> dict:
+    """Index a shared telemetry dir by kind -> (process, attempt) -> path.
+
+    Accepts BOTH layouts: the stamped fleet layout
+    (``trace_p0_a1.json`` ...) and the pre-fleet single-process layout
+    (``trace.json`` ..., mapped to process 0) — readers must not break on
+    dirs written by the previous release. Goodput sidecars are keyed by
+    process only (attempts live inside the records)."""
+    kinds: dict = {"anchor": {}, "trace": {}, "spans": {}, "stats": {},
+                   "goodput": {}, "flight": []}
+    try:
+        names = sorted(os.listdir(dir_path))
+    except OSError:
+        return kinds
+    for name in names:
+        path = os.path.join(dir_path, name)
+        m = _STAMP_RE.match(name)
+        if m:
+            root = m.group("root")
+            p = int(m.group("p"))
+            if root == "goodput":
+                kinds["goodput"][p] = path
+            else:
+                a = int(m.group("a") or 0)
+                kinds[root][(p, a)] = path
+            continue
+        if name in _LEGACY:
+            root, p, a = _LEGACY[name]
+            if root == "goodput":
+                kinds["goodput"].setdefault(p, path)
+            else:
+                kinds[root].setdefault((p, a), path)
+            continue
+        fm = _FLIGHT_RE.match(name)
+        if fm:
+            kinds["flight"].append({
+                "file": name,
+                "reason": fm.group("reason"),
+                "process_index": int(fm.group("p") or 0),
+                "attempt": int(fm.group("a")),
+            })
+    return kinds
+
+
+def goodput_paths(dir_path: str) -> dict[int, str]:
+    """Per-process goodput sidecar paths, both layouts (the satellite-2
+    reader: a shared dir holds ``goodput_p{P}.jsonl`` per process; an
+    old dir holds one unstamped ``goodput.jsonl`` for process 0)."""
+    return discover(dir_path)["goodput"]
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# trace merge
+# ---------------------------------------------------------------------------
+
+
+def _anchor_offset(anchor: dict | None, t0_s: float) -> tuple[float, bool]:
+    """Wall-epoch seconds of a trace's ts==0 instant, and whether it came
+    from a real anchor. Unanchored traces (pre-fleet dirs) sit at wall 0
+    — visibly unaligned rather than silently overlaid on anchored ones."""
+    if anchor and "wall_epoch_s" in anchor and "span_clock_s" in anchor:
+        return (float(anchor["wall_epoch_s"])
+                + (float(t0_s) - float(anchor["span_clock_s"])), True)
+    return (0.0, False)
+
+
+def merge_traces(dir_path: str, discovered: dict | None = None) -> dict:
+    """Merge every per-process Chrome trace in ``dir_path`` onto one
+    wall-aligned timeline: pid = process index, tid = attempt + 1, with
+    ``M`` metadata events naming each track. Returns the merged trace
+    dict (``traceEvents`` sorted, globally non-decreasing ``ts``) with
+    an extra ``fleet`` block recording the per-source alignment."""
+    kinds = discovered or discover(dir_path)
+    sources = []
+    for (p, a), path in sorted(kinds["trace"].items()):
+        trace = _read_json(path)
+        if not isinstance(trace, dict):
+            continue
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            continue
+        anchor = _read_json(kinds["anchor"].get((p, a), ""))
+        wall0, anchored = _anchor_offset(anchor, trace.get("t0_s", 0.0))
+        sources.append({"p": p, "a": a, "events": events, "wall0": wall0,
+                        "anchored": anchored, "file": os.path.basename(path)})
+    if not sources:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "fleet": {"sources": []}}
+    zero = min(s["wall0"] for s in sources)
+    merged = []
+    meta = []
+    for s in sources:
+        pid, tid = s["p"], s["a"] + 1
+        meta.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                     "args": {"name": f"process {pid}"}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                     "args": {"name": f"attempt {s['a']}"}})
+        base_us = (s["wall0"] - zero) * 1e6
+        for seq, ev in enumerate(s["events"]):
+            if not isinstance(ev, dict) or "ts" not in ev:
+                continue
+            out = dict(ev)
+            out["pid"], out["tid"] = pid, tid
+            out["ts"] = int(round(base_us + ev["ts"]))
+            merged.append((out["ts"], pid, tid, seq, out))
+    # Stable per-track order (seq) under a global time sort: each track's
+    # internal B/E discipline survives the interleave, so the merged
+    # stream validates per-(pid, tid).
+    merged.sort(key=lambda t: t[:4])
+    trace = {
+        "traceEvents": meta + [t[4] for t in merged],
+        "displayTimeUnit": "ms",
+        "fleet": {
+            "sources": [{k: s[k] for k in ("p", "a", "file", "anchored")}
+                        for s in sources],
+            "zero_wall_epoch_s": round(zero, 6),
+        },
+    }
+    return trace
+
+
+# ---------------------------------------------------------------------------
+# pod goodput
+# ---------------------------------------------------------------------------
+
+
+def aggregate_goodput(dir_path: str, discovered: dict | None = None
+                      ) -> dict | None:
+    """Roll N processes' goodput sidecars into one pod summary.
+
+    Every attempt record's categories sum exactly to its wall (ledger
+    close computes ``other`` as the residual), so the pod categories sum
+    to pod wall by construction; the only slack is the 6-decimal
+    rounding each record already committed, which is folded into
+    ``other`` and reported as ``rounding_residual_s`` (0.0 under the
+    fake-clock tests). None when no sidecar holds any record."""
+    kinds = discovered or discover(dir_path)
+    total = 0.0
+    cats: dict[str, float] = {}
+    attempts = 0
+    steps_productive = 0
+    steps_replayed = 0
+    processes = []
+    for p, path in sorted(kinds["goodput"].items()):
+        recs = read_goodput(path)
+        if not recs:
+            continue
+        processes.append(p)
+        for rec in recs:
+            if rec.get("record") == "attempt":
+                attempts += 1
+                total += float(rec.get("wall_s", 0.0))
+                steps_productive += int(rec.get("steps_productive", 0))
+                steps_replayed += int(rec.get("steps_replayed", 0))
+                for k, v in (rec.get("categories") or {}).items():
+                    cats[k] = cats.get(k, 0.0) + float(v)
+            elif rec.get("record") == "backoff":
+                b = float(rec.get("backoff_s", 0.0))
+                total += b
+                cats["restart_backoff"] = cats.get("restart_backoff", 0.0) + b
+    if not processes or total <= 0.0:
+        return None
+    residual = total - sum(cats.values())
+    cats["other"] = cats.get("other", 0.0) + residual
+    out_cats = {k: round(v, 6) for k, v in sorted(cats.items())}
+    # Exactness is the contract: re-round the residual category so the
+    # emitted numbers sum to the emitted wall to the last decimal.
+    wall = round(total, 6)
+    out_cats["other"] = round(
+        wall - sum(v for k, v in out_cats.items() if k != "other"), 6
+    )
+    return {
+        "wall_s": wall,
+        "categories": out_cats,
+        "goodput_fraction": round(
+            out_cats.get("productive_step", 0.0) / wall, 6
+        ) if wall else 0.0,
+        "attempts": attempts,
+        "processes": processes,
+        "steps_productive": steps_productive,
+        "steps_replayed": steps_replayed,
+        "rounding_residual_s": round(residual, 9),
+    }
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+
+def _read_spans(path: str) -> list[dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    """Ceil-rank percentile of an already-sorted list (exact, small-N)."""
+    rank = max(1, math.ceil(q / 100.0 * len(sorted_vals)))
+    return sorted_vals[rank - 1]
+
+
+def straggler_report(dir_path: str, discovered: dict | None = None,
+                     span_name: str = "step") -> dict:
+    """Per-step cross-host skew from the wall-aligned span streams.
+
+    For every step number completed by ALL reporting processes, the skew
+    is (latest aligned end) - (earliest aligned end); the host that ends
+    last is that step's straggler. ``persistent_offender`` is the
+    process slowest in >= ``OFFENDER_FRAC`` of the last
+    ``OFFENDER_WINDOW`` common steps (None when no one is) — the signal
+    the elastic supervisor's shrink policy keys on."""
+    kinds = discovered or discover(dir_path)
+    # step -> process -> latest aligned end time (replays overwrite:
+    # the last completion of a step is the one that counts).
+    ends: dict[int, dict[int, float]] = {}
+    procs: set[int] = set()
+    for (p, a), path in sorted(kinds["spans"].items()):
+        anchor = _read_json(kinds["anchor"].get((p, a), ""))
+        wall0, anchored = _anchor_offset(anchor, 0.0)
+        for rec in _read_spans(path):
+            if rec.get("span") != span_name:
+                continue
+            step = rec.get("step", -1)
+            if not isinstance(step, int) or step < 0:
+                continue
+            end = wall0 + float(rec.get("t_s", 0.0)) \
+                + float(rec.get("dur_ms", 0.0)) / 1e3
+            procs.add(p)
+            ends.setdefault(step, {})[p] = end
+    report = {
+        "span": span_name,
+        "processes": sorted(procs),
+        "common_steps": 0,
+        "skew_s": None,
+        "slowest": None,
+        "persistent_offender": None,
+        "window": OFFENDER_WINDOW,
+        "threshold": OFFENDER_FRAC,
+    }
+    if len(procs) < 2:
+        return report
+    common = sorted(s for s, by in ends.items() if len(by) == len(procs))
+    report["common_steps"] = len(common)
+    if not common:
+        return report
+    skews = []
+    slowest_by_step = []
+    for s in common:
+        by = ends[s]
+        slowest_p = max(by, key=lambda p: by[p])
+        skews.append(max(by.values()) - min(by.values()))
+        slowest_by_step.append(slowest_p)
+    ss = sorted(skews)
+    report["skew_s"] = {
+        "p50": round(_pct(ss, 50), 6),
+        "p99": round(_pct(ss, 99), 6),
+        "max": round(ss[-1], 6),
+        "mean": round(sum(ss) / len(ss), 6),
+    }
+    counts: dict[int, int] = {}
+    for p in slowest_by_step:
+        counts[p] = counts.get(p, 0) + 1
+    top = max(counts, key=lambda p: counts[p])
+    report["slowest"] = {
+        "process_index": top,
+        "frac_slowest": round(counts[top] / len(common), 6),
+    }
+    window = slowest_by_step[-OFFENDER_WINDOW:]
+    wcounts: dict[int, int] = {}
+    for p in window:
+        wcounts[p] = wcounts.get(p, 0) + 1
+    wtop = max(wcounts, key=lambda p: wcounts[p])
+    if wcounts[wtop] / len(window) >= OFFENDER_FRAC:
+        report["persistent_offender"] = wtop
+    return report
+
+
+# ---------------------------------------------------------------------------
+# histogram / gauge merge
+# ---------------------------------------------------------------------------
+
+
+def merge_stats(dir_path: str, discovered: dict | None = None) -> dict:
+    """Merge every process's stats record: latency histograms bucket-wise
+    (merge == histogram-of-union), gauges to a fleet digest (max of
+    maxes; per-process lasts kept — queue depth is per-replica state,
+    summing lasts would fabricate a number no process ever saw), and
+    the executable registries side by side."""
+    kinds = discovered or discover(dir_path)
+    hists: dict[str, LatencyHistogram] = {}
+    gauges_max: dict = {}
+    gauges_last: dict[str, dict] = {}
+    registries: dict[str, dict] = {}
+    n = 0
+    for (p, a), path in sorted(kinds["stats"].items()):
+        rec = _read_json(path)
+        if not isinstance(rec, dict):
+            continue
+        n += 1
+        for name, hrec in (rec.get("histograms") or {}).items():
+            try:
+                h = LatencyHistogram.from_dict(hrec)
+            except (KeyError, TypeError, ValueError):
+                continue
+            if name in hists:
+                try:
+                    hists[name].merge(h)
+                except ValueError:
+                    pass  # layout drift across releases: keep the first
+            else:
+                hists[name] = h
+        g = rec.get("gauges") or {}
+        for k, v in (g.get("max") or {}).items():
+            prev = gauges_max.get(k)
+            if prev is None or (isinstance(v, (int, float)) and v > prev):
+                gauges_max[k] = v
+        if g.get("last"):
+            gauges_last[f"p{p}"] = g["last"]
+        reg = (rec.get("registry") or {}).get("executables")
+        if reg:
+            registries[f"p{p}_a{a}"] = reg
+    return {
+        "stats_files": n,
+        "histograms": {k: h.summary() for k, h in sorted(hists.items())},
+        "gauges": {"max": gauges_max, "last_by_process": gauges_last},
+        "registries": registries,
+        "_hists": hists,  # live objects for callers; stripped by build_fleet
+    }
+
+
+# ---------------------------------------------------------------------------
+# FLEET.json
+# ---------------------------------------------------------------------------
+
+
+def build_fleet(dir_path: str, *, write: bool = True) -> dict:
+    """The full aggregation pass over one shared telemetry dir.
+
+    Writes ``trace_merged.json`` and ``FLEET.json`` into the dir (unless
+    ``write=False``) and returns the fleet record. Schema (pinned by
+    tests/test_fleet.py; documented in docs/OBSERVABILITY.md)::
+
+        {"schema_version": 1, "utc": ..., "dir": ...,
+         "processes": [...], "attempts_seen": N,
+         "goodput": {pod summary | null},
+         "straggler": {...}, "histograms": {...}, "gauges": {...},
+         "flights": [...],
+         "trace": {"events": N, "valid": bool, "problems": [...],
+                   "path": "trace_merged.json" | null},
+         "headline": {"pod_goodput_fraction": ..., "max_step_skew_s": ...}}
+    """
+    kinds = discover(dir_path)
+    merged = merge_traces(dir_path, kinds)
+    problems = validate_chrome_trace(merged)
+    goodput = aggregate_goodput(dir_path, kinds)
+    straggler = straggler_report(dir_path, kinds)
+    stats = merge_stats(dir_path, kinds)
+    stats.pop("_hists", None)
+    processes = sorted(
+        {p for (p, _a) in kinds["trace"]}
+        | {p for (p, _a) in kinds["spans"]}
+        | set(kinds["goodput"])
+    )
+    trace_path = None
+    if write and merged["traceEvents"]:
+        trace_path = os.path.join(dir_path, "trace_merged.json")
+        tmp = trace_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(merged, f)
+                f.write("\n")
+            os.replace(tmp, trace_path)
+        except OSError:
+            trace_path = None
+    skew = (straggler.get("skew_s") or {})
+    fleet = {
+        "schema_version": FLEET_SCHEMA_VERSION,
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "dir": os.path.abspath(dir_path),
+        "processes": processes,
+        "attempts_seen": len(kinds["trace"]),
+        "goodput": goodput,
+        "straggler": straggler,
+        "histograms": stats["histograms"],
+        "gauges": stats["gauges"],
+        "registries": stats["registries"],
+        "flights": sorted(kinds["flight"], key=lambda f: f["file"]),
+        "trace": {
+            "events": len(merged["traceEvents"]),
+            "valid": not problems,
+            "problems": problems[:8],
+            "path": os.path.basename(trace_path) if trace_path else None,
+            "sources": merged.get("fleet", {}).get("sources", []),
+        },
+        "headline": {
+            "pod_goodput_fraction":
+                goodput["goodput_fraction"] if goodput else None,
+            "max_step_skew_s": skew.get("max"),
+        },
+    }
+    if write:
+        tmp = os.path.join(dir_path, "FLEET.json.tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(fleet, f, indent=2)
+                f.write("\n")
+            os.replace(tmp, os.path.join(dir_path, "FLEET.json"))
+        except OSError:
+            pass
+    return fleet

@@ -34,11 +34,12 @@ def _run_report(src_dir):
 
 def _check_schema(rec):
     assert rec["schema_version"] == 1
-    assert rec["source_glob"] == "BENCH_*.json"
+    assert rec["source_glob"] == "BENCH_*.json + FLEET.json"
     assert isinstance(rec["artifacts"], dict)
     assert isinstance(rec["unreadable"], dict)
     for name, entry in rec["artifacts"].items():
-        assert name.startswith("BENCH_") and name.endswith(".json")
+        assert name.endswith(".json")
+        assert name.startswith("BENCH_") or name == "FLEET.json"
         assert set(entry) == {"utc", "keys", "headline"}
         assert isinstance(entry["keys"], list)
         assert isinstance(entry["headline"], dict)
@@ -54,9 +55,20 @@ def test_report_on_synthetic_corpus(tmp_path):
         {"weird_metric": 3.5, "_private": 9}
     ))
     (tmp_path / "BENCH_BAD.json").write_text("{not json")
+    # FLEET.json rides along: the fleet aggregator's pod headline is
+    # surfaced in the index even though it doesn't match BENCH_*.json.
+    (tmp_path / "FLEET.json").write_text(json.dumps(
+        {"utc": "2026-01-01T00:00:00Z", "schema_version": 1,
+         "headline": {"pod_goodput_fraction": 0.42,
+                      "max_step_skew_s": 0.003}}
+    ))
     rec = _run_report(tmp_path)
     _check_schema(rec)
-    assert set(rec["artifacts"]) == {"BENCH_A.json", "BENCH_B.json"}
+    assert set(rec["artifacts"]) == {
+        "BENCH_A.json", "BENCH_B.json", "FLEET.json"}
+    fleet = rec["artifacts"]["FLEET.json"]["headline"]
+    assert fleet["pod_goodput_fraction"] == 0.42
+    assert fleet["max_step_skew_s"] == 0.003
     a = rec["artifacts"]["BENCH_A.json"]
     assert a["headline"]["steps_per_sec"] == 12.5
     assert a["headline"]["n_rows"] == 2

@@ -1,0 +1,338 @@
+"""Fleet aggregation pins (telemetry_aggregate.py): fake-clock 2-process
+fixtures exercising the whole tentpole.
+
+Everything here runs real :class:`telemetry.Telemetry` bundles with
+injected clocks — the artifacts in the shared dir are EXACTLY what two
+``cli launch`` children would write (stamped names, eager anchors, span
+jsonl, stats records, goodput sidecars) — then asserts the aggregator's
+contracts:
+
+- pod goodput categories sum EXACTLY to the pod wall clock;
+- the merged Perfetto trace passes ``validate_chrome_trace``, including
+  when a source's span ring evicted its oldest spans;
+- ``LatencyHistogram`` merge == histogram-of-union (fleet percentiles
+  without shipping samples);
+- skew detection flags a synthetic straggler (slowest + persistent
+  offender);
+- the FLEET.json schema (tier-1 pinned — what ``cli report`` and
+  ``tools/telemetry_report.py --check`` consume).
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from distributeddeeplearning_tpu.telemetry import (
+    LatencyHistogram,
+    Telemetry,
+    validate_chrome_trace,
+)
+from distributeddeeplearning_tpu.telemetry_aggregate import (
+    FLEET_SCHEMA_VERSION,
+    aggregate_goodput,
+    build_fleet,
+    discover,
+    goodput_paths,
+    merge_stats,
+    merge_traces,
+    straggler_report,
+)
+
+EPOCH0 = 1_700_000_000.0  # arbitrary wall-clock epoch shared by the pod
+
+
+class FakeClock:
+    """Injectable monotonic clock; one instance drives a process's span,
+    wall and epoch clocks so their relationship is exact by construction."""
+
+    def __init__(self, start: float):
+        self.t = float(start)
+        self.base = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+    def epoch(self) -> float:
+        # Same instant on the shared wall-clock axis: every process's
+        # epoch reads EPOCH0 + elapsed-since-construction.
+        return EPOCH0 + (self.t - self.base)
+
+
+def _run_process(tdir, p, *, step_s, steps=40, ring_size=4096,
+                 span_base=None, compile_s=2.0, start_delay=0.0):
+    """One simulated training process: anchor, spans, goodput, stats.
+
+    ``span_base`` sets the process-private monotonic origin (different
+    per process, like real hosts); ``start_delay`` shifts this process's
+    wall-clock start. Returns the Telemetry bundle (artifacts written)."""
+    clk = FakeClock(1000.0 * (p + 1) if span_base is None else span_base)
+    if start_delay:
+        # Construction-time delay moves the epoch anchor, not the span
+        # axis relationship.
+        clk.base -= start_delay
+    tel = Telemetry(
+        enabled=True, out_dir=str(tdir), attempt=0, process_index=p,
+        ring_size=ring_size, span_clock=clk, wall_clock=clk,
+        epoch_clock=clk.epoch,
+    )
+    tel.ledger.open(0)
+    tel.ledger.add("compile", compile_s)
+    clk.advance(compile_s)
+    for i in range(steps):
+        with tel.span("step", step=i):
+            clk.advance(step_s)
+        tel.ledger.step_time(step_s, i)
+        tel.hist("ttft").record(step_s / 4)
+    tel.note_gauges({"pending": 3 + p, "free_blocks": 100 - p})
+    tel.ledger.close(final_step=steps - 1)
+    tel.write_trace()
+    return tel
+
+
+def _make_fleet_dir(tmp_path, *, steps=40, slow_extra=0.04, **kw):
+    """Two processes sharing one telemetry dir; process 1 is the synthetic
+    straggler (every step ``slow_extra`` seconds longer)."""
+    _run_process(tmp_path, 0, step_s=0.100, steps=steps, **kw)
+    _run_process(tmp_path, 1, step_s=0.100 + slow_extra, steps=steps, **kw)
+    return str(tmp_path)
+
+
+def test_discover_indexes_stamped_layout(tmp_path):
+    d = _make_fleet_dir(tmp_path)
+    kinds = discover(d)
+    assert set(kinds["trace"]) == {(0, 0), (1, 0)}
+    assert set(kinds["spans"]) == {(0, 0), (1, 0)}
+    assert set(kinds["anchor"]) == {(0, 0), (1, 0)}
+    assert set(kinds["stats"]) == {(0, 0), (1, 0)}
+    assert set(kinds["goodput"]) == {0, 1}
+    assert set(goodput_paths(d)) == {0, 1}
+
+
+def test_pod_goodput_categories_sum_exactly(tmp_path):
+    d = _make_fleet_dir(tmp_path)
+    g = aggregate_goodput(d)
+    assert g is not None
+    assert g["processes"] == [0, 1]
+    assert g["attempts"] == 2
+    assert g["steps_productive"] == 80 and g["steps_replayed"] == 0
+    # THE exactness pin: emitted categories sum to the emitted wall to
+    # the last decimal — no float drift, no hidden residual.
+    assert round(sum(g["categories"].values()), 6) == g["wall_s"]
+    # Wall = 2 compiles + both processes' step time (fake clocks: exact).
+    expected_wall = 2 * 2.0 + 40 * 0.100 + 40 * 0.140
+    assert g["wall_s"] == pytest.approx(expected_wall, abs=1e-5)
+    assert g["goodput_fraction"] == pytest.approx(
+        (40 * 0.100 + 40 * 0.140) / expected_wall, abs=1e-5
+    )
+    assert abs(g["rounding_residual_s"]) < 1e-5
+
+
+def test_merged_trace_valid_and_wall_aligned(tmp_path):
+    d = _make_fleet_dir(tmp_path)
+    merged = merge_traces(d)
+    assert validate_chrome_trace(merged) == []
+    evs = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    # 2 processes x 40 steps x (B + E).
+    assert len(evs) == 2 * 40 * 2
+    assert {e["pid"] for e in evs} == {0, 1}
+    # Global timestamp sort across sources.
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # Both sources anchored; identical construction epoch => same zero.
+    srcs = {s["p"]: s for s in merged["fleet"]["sources"]}
+    assert srcs[0]["anchored"] and srcs[1]["anchored"]
+    # p0 finishes step 0 at epoch+2.0+0.1; p1 at epoch+2.0+0.14: the
+    # first E event on each pid lands 40ms apart on the merged axis.
+    first_e = {}
+    for e in evs:
+        if e["ph"] == "E" and e["pid"] not in first_e:
+            first_e[e["pid"]] = e["ts"]
+    assert first_e[1] - first_e[0] == pytest.approx(0.04e6, abs=2)
+
+
+def test_merged_trace_valid_with_ring_eviction(tmp_path):
+    # ring_size 8 << 40 steps: the oldest spans are evicted, so each
+    # process's trace holds only the newest 8 — the merge must still be a
+    # well-formed B/E stream (eviction drops matched pairs, never half).
+    d = _make_fleet_dir(tmp_path, ring_size=8)
+    merged = merge_traces(d)
+    assert validate_chrome_trace(merged) == []
+    evs = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    assert len(evs) == 2 * 8 * 2
+    fleet = build_fleet(d)
+    assert fleet["trace"]["valid"]
+    # Straggler detection degrades gracefully to the surviving window.
+    assert fleet["straggler"]["common_steps"] == 8
+    assert fleet["straggler"]["persistent_offender"] == 1
+
+
+def test_histogram_merge_equals_union():
+    rng_state = 12345
+    def lcg():  # deterministic pseudo-random samples, no global RNG
+        nonlocal rng_state
+        rng_state = (1103515245 * rng_state + 12345) % (1 << 31)
+        return rng_state / (1 << 31)
+    a, b, union = LatencyHistogram(), LatencyHistogram(), LatencyHistogram()
+    xs_a = [1e-4 * math.exp(6 * lcg()) for _ in range(700)]
+    xs_b = [1e-3 * math.exp(4 * lcg()) for _ in range(300)]
+    for x in xs_a:
+        a.record(x)
+    for x in xs_b:
+        b.record(x)
+    for x in xs_a + xs_b:
+        union.record(x)
+    merged = LatencyHistogram.from_dict(a.to_dict()).merge(
+        LatencyHistogram.from_dict(b.to_dict())
+    )
+    assert merged.counts == union.counts
+    assert merged.count == union.count == 1000
+    assert sum(merged.counts) == merged.count  # exact-count invariant
+    assert merged.min == union.min and merged.max == union.max
+    for q in (50, 90, 99):
+        assert merged.percentile(q) == union.percentile(q)
+    # Layout mismatch is a refusal, not silent garbage.
+    with pytest.raises(ValueError):
+        a.merge(LatencyHistogram(n=64))
+
+
+def test_merge_stats_merges_fleet_histograms(tmp_path):
+    d = _make_fleet_dir(tmp_path)
+    stats = merge_stats(d)
+    assert stats["stats_files"] == 2
+    # Per-process "step" histograms (40 samples each) merged to 80.
+    assert stats["histograms"]["step"]["count"] == 80
+    assert stats["histograms"]["ttft"]["count"] == 80
+    # Gauge digest: max of maxes; per-process lasts kept apart.
+    assert stats["gauges"]["max"]["pending"] == 4
+    assert set(stats["gauges"]["last_by_process"]) == {"p0", "p1"}
+    # Merge == union on the real fixture: p50 of the merged step
+    # histogram sits between the two processes' step durations.
+    p50 = stats["histograms"]["step"]["p50_s"]
+    assert 0.100 * 0.92 <= p50 <= 0.140 * 1.09  # within one bucket width
+
+
+def test_straggler_detection_flags_synthetic_straggler(tmp_path):
+    d = _make_fleet_dir(tmp_path, slow_extra=0.04)
+    rep = straggler_report(d)
+    assert rep["processes"] == [0, 1]
+    assert rep["common_steps"] == 40
+    assert rep["slowest"] == {"process_index": 1, "frac_slowest": 1.0}
+    assert rep["persistent_offender"] == 1
+    # Cumulative lateness: skew at step i is (i+1)*0.04 — max at the
+    # last step, p50 at the ceil-rank midpoint.
+    skew = rep["skew_s"]
+    assert skew["max"] == pytest.approx(40 * 0.04, abs=1e-3)
+    assert skew["p50"] == pytest.approx(20 * 0.04, abs=1e-3)
+    assert skew["p50"] <= skew["p99"] <= skew["max"]
+
+
+def test_straggler_none_when_balanced(tmp_path):
+    d = _make_fleet_dir(tmp_path, slow_extra=0.0)
+    rep = straggler_report(d)
+    assert rep["common_steps"] == 40
+    assert rep["skew_s"]["max"] < 1e-3
+    # Clock-fence jitter may crown an arbitrary "slowest", but nobody
+    # should be a persistent offender by margin... fence bumps are 1ns
+    # and deterministic per-track, so one process CAN win every step.
+    # The meaningful pin is the skew magnitude above, plus:
+    assert rep["persistent_offender"] in (None, 0, 1)
+
+
+def test_single_process_no_straggler_report(tmp_path):
+    _run_process(tmp_path, 0, step_s=0.1)
+    rep = straggler_report(str(tmp_path))
+    assert rep["processes"] == [0]
+    assert rep["common_steps"] == 0
+    assert rep["skew_s"] is None and rep["persistent_offender"] is None
+
+
+def test_legacy_unstamped_layout_maps_to_process_zero(tmp_path):
+    # A pre-fleet dir: unstamped trace.json / spans.jsonl / goodput.jsonl
+    # and no anchor — must aggregate (as process 0, unanchored), not break.
+    tel = _run_process(tmp_path, 0, step_s=0.1, steps=4)
+    for stamped_name, legacy in (
+        (os.path.basename(tel.trace_path), "trace.json"),
+        (os.path.basename(tel.spans_path), "spans.jsonl"),
+        ("goodput_p0.jsonl", "goodput.jsonl"),
+    ):
+        os.rename(os.path.join(str(tmp_path), stamped_name),
+                  os.path.join(str(tmp_path), legacy))
+    os.remove(os.path.join(str(tmp_path), "anchor_p0_a0.json"))
+    os.remove(os.path.join(str(tmp_path), "stats_p0_a0.json"))
+    kinds = discover(str(tmp_path))
+    assert set(kinds["trace"]) == {(0, 0)}
+    assert set(kinds["goodput"]) == {0}
+    merged = merge_traces(str(tmp_path))
+    assert validate_chrome_trace(merged) == []
+    assert merged["fleet"]["sources"][0]["anchored"] is False
+    g = aggregate_goodput(str(tmp_path))
+    assert g is not None and g["processes"] == [0]
+    assert round(sum(g["categories"].values()), 6) == g["wall_s"]
+
+
+def test_fleet_json_schema(tmp_path):
+    d = _make_fleet_dir(tmp_path)
+    fleet = build_fleet(d)
+    # Written artifacts.
+    assert os.path.exists(os.path.join(d, "FLEET.json"))
+    assert os.path.exists(os.path.join(d, "trace_merged.json"))
+    with open(os.path.join(d, "FLEET.json")) as f:
+        on_disk = json.load(f)
+    assert on_disk == fleet
+    # Pinned schema (docs/OBSERVABILITY.md).
+    assert fleet["schema_version"] == FLEET_SCHEMA_VERSION == 1
+    assert set(fleet) == {
+        "schema_version", "utc", "dir", "processes", "attempts_seen",
+        "goodput", "straggler", "histograms", "gauges", "registries",
+        "flights", "trace", "headline",
+    }
+    assert fleet["processes"] == [0, 1]
+    assert fleet["attempts_seen"] == 2
+    assert set(fleet["trace"]) == {"events", "valid", "problems", "path",
+                                   "sources"}
+    assert fleet["trace"]["valid"] and fleet["trace"]["problems"] == []
+    assert fleet["trace"]["path"] == "trace_merged.json"
+    assert set(fleet["headline"]) == {"pod_goodput_fraction",
+                                      "max_step_skew_s"}
+    assert 0.0 < fleet["headline"]["pod_goodput_fraction"] <= 1.0
+    assert fleet["headline"]["max_step_skew_s"] > 0.0
+    # The merged trace on disk revalidates.
+    with open(os.path.join(d, "trace_merged.json")) as f:
+        assert validate_chrome_trace(json.load(f)) == []
+    # Histogram summaries carry the report-facing digest shape.
+    step = fleet["histograms"]["step"]
+    assert set(step) == {"count", "p50_s", "p99_s", "mean_s", "min_s",
+                         "max_s", "rel_error"}
+
+
+def test_build_fleet_empty_dir(tmp_path):
+    fleet = build_fleet(str(tmp_path))
+    assert fleet["processes"] == []
+    assert fleet["goodput"] is None
+    assert fleet["trace"]["events"] == 0
+    assert fleet["headline"]["pod_goodput_fraction"] is None
+    # No merged trace fabricated for an empty dir.
+    assert fleet["trace"]["path"] is None
+
+
+def test_committed_fleet_artifact():
+    """The committed FLEET.json (tools/telemetry_report.py fleet
+    rehearsal over a real 2-child ``cli launch --independent`` run) obeys
+    the same invariants the synthetic fixtures pin."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "FLEET.json")
+    if not os.path.exists(path):
+        pytest.skip("FLEET.json not yet generated")
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(repo, "tools",
+                                         "telemetry_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check_fleet(path) == []

@@ -381,6 +381,9 @@ def test_launch_plan_threads_coordinator_env():
         assert env["COORDINATOR_ADDRESS"] == "localhost:12345"
         assert env["NUM_PROCESSES"] == "2"
         assert env["PROCESS_ID"] == str(pid)
+        # Fleet-telemetry stamp: every child knows its index even before
+        # jax distributed init (telemetry.resolve_process_index reads it).
+        assert env["DDL_PROCESS_INDEX"] == str(pid)
         assert env["KEEP"] == "me"
         # Device pinning goes through the same compat shim the tests use.
         assert env["JAX_PLATFORMS"] == "cpu"
@@ -402,6 +405,29 @@ def test_launch_plan_defaults():
     assert len(addrs) == 1
     port = int(addrs.pop().rsplit(":", 1)[1])
     assert 0 < port < 65536
+
+
+def test_launch_plan_independent_mode():
+    # --independent: N uncoordinated single-process children sharing one
+    # telemetry dir — the fleet-observability rehearsal mode on jax builds
+    # whose CPU backend has no multiprocess rendezvous. No coordinator
+    # env (each child is its own world); the process stamp still set.
+    from distributeddeeplearning_tpu import cli
+
+    plan = cli._launch_plan(
+        "cfg.py", [], 2, devices_per_process=2,
+        base_env={"PROCESS_ID": "7", "COORDINATOR_ADDRESS": "stale:1"},
+        independent=True,
+    )
+    assert len(plan) == 2
+    for pid, (_cmd, env) in enumerate(plan):
+        assert env["DDL_PROCESS_INDEX"] == str(pid)
+        # Inherited coordination env is scrubbed, not leaked: a stale
+        # PROCESS_ID would both misconfigure jax and mis-stamp telemetry.
+        assert "COORDINATOR_ADDRESS" not in env
+        assert "NUM_PROCESSES" not in env
+        assert "PROCESS_ID" not in env
+        assert env["JAX_NUM_CPU_DEVICES"] == "2"
 
 
 def test_launch_plan_rejects_single_process():

@@ -52,6 +52,15 @@ def _check_shape(rec, n_requests):
         assert row["tokens_per_sec_per_chip"] > 0
         assert row["ttft_s"]["p99"] >= row["ttft_s"]["p50"] > 0
         assert row["inter_token_s"]["p99"] >= row["inter_token_s"]["p50"] > 0
+        # TTFT now comes from the streaming log-bucket histogram; the
+        # exact sorted-sample order statistics ride along and the two
+        # must agree within one bucket's relative width.
+        assert row["ttft_exact_s"]["p99"] >= row["ttft_exact_s"]["p50"] > 0
+        hve = row["ttft_hist_vs_exact"]
+        assert hve["ok"] is True
+        assert hve["max_rel_dev"] <= hve["bound"] + 1e-9
+        # Queueing delay histogram (admission wait) is always populated.
+        assert row["queue_s"]["p99"] >= row["queue_s"]["p50"] >= 0
         assert 0 < row["block_high_water"] <= row["num_blocks"]
         # per-phase host latency from the engine's telemetry spans
         for phase in ("schedule", "prefill", "decode"):
@@ -64,6 +73,7 @@ def _check_shape(rec, n_requests):
         assert row["compiles_after_run"] == row["compiles_warmup"]
     comp = rec["comparison"]
     assert comp["zero_recompiles_in_steady_state"] is True
+    assert comp["hist_percentiles_within_bucket_error"] is True
     # kernel selection changes the read path, never the tokens
     assert comp["pallas_tokens_match_reference"] is True
     assert comp["decode_donation_live"] is True

@@ -421,8 +421,14 @@ def test_fit_e2e_writes_valid_artifacts(tmp_path):
     assert validate_chrome_trace(trace) == []
     names = {ev["name"] for ev in trace["traceEvents"]}
     assert {"step", "data_wait", "dispatch", "device_wait"} <= names
-    with open(os.path.join(tel.dir, "spans.jsonl")) as f:
+    # Fleet-stamped artifact names: process 0, this attempt.
+    assert tel.spans_path.endswith("spans_p0_a0.jsonl")
+    with open(tel.spans_path) as f:
         assert all(json.loads(ln)["event"] == "span" for ln in f)
+    # The clock-alignment anchor was written eagerly at open.
+    with open(tel.anchor_path) as f:
+        anchor = json.load(f)
+    assert anchor["record"] == "anchor" and anchor["process_index"] == 0
 
 
 def test_fit_nan_rollback_dumps_flight_record(tmp_path):
@@ -439,7 +445,7 @@ def test_fit_nan_rollback_dumps_flight_record(tmp_path):
             telemetry=tel,
         )
     tel.ledger.close()
-    flight = os.path.join(tel.dir, "flight_health_rollback_attempt0.json")
+    flight = os.path.join(tel.dir, "flight_health_rollback_p0_attempt0.json")
     assert os.path.exists(flight)
     with open(flight) as f:
         rec = json.load(f)
@@ -525,8 +531,16 @@ def test_cmd_report_renders_dir(tmp_path, capsys):
     assert cmd_report(tdir) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["goodput"]["attempts"] == 1
-    assert out["trace"]["valid"] is True and out["trace"]["events"] == 2
-    assert out["flights"] == ["flight_unit_test_attempt0.json"]
+    # The merged trace carries the 2 span events plus this process's two
+    # M (track-name) metadata events.
+    assert out["trace"]["valid"] is True and out["trace"]["events"] == 4
+    assert out["flights"] == ["flight_unit_test_p0_attempt0.json"]
+    assert out["processes"] == [0]
+    assert out["headline"]["pod_goodput_fraction"] is not None
+    # cmd_report is now the fleet aggregation pass: FLEET.json + the
+    # merged trace land in the dir.
+    assert os.path.exists(os.path.join(tdir, "FLEET.json"))
+    assert os.path.exists(os.path.join(tdir, "trace_merged.json"))
 
 
 def test_telemetry_artifact_check(tmp_path):

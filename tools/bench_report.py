@@ -1,4 +1,4 @@
-"""Benchmark-trajectory report: BENCH_*.json -> BENCH_TRAJECTORY.json.
+"""Benchmark-trajectory report: BENCH_*.json + FLEET.json -> BENCH_TRAJECTORY.json.
 
 The repo accumulates one benchmark artifact per subsystem (overlap,
 mixed precision, fused dispatch, serving, multislice, the per-round
@@ -76,6 +76,13 @@ def _headline(rec: dict) -> dict:
                   "pallas_tokens_match_reference", "decode_donation_live"):
             if k in comp:
                 out[k] = comp[k]
+    # FLEET.json (tools/telemetry_report.py fleet rehearsal): the pod-level
+    # headline the aggregator exists for.
+    fh = rec.get("headline")
+    if isinstance(fh, dict):
+        for k in ("pod_goodput_fraction", "max_step_skew_s"):
+            if k in fh:
+                out[k] = fh[k]
     comps = rec.get("comparisons")
     if isinstance(comps, dict):
         reductions = [c["dcn_byte_reduction"] for c in comps.values()
@@ -94,7 +101,14 @@ def _headline(rec: dict) -> dict:
 def main() -> int:
     artifacts: dict = {}
     unreadable: dict = {}
-    for path in sorted(glob.glob(os.path.join(_DIR, "BENCH_*.json"))):
+    # FLEET.json rides along with the BENCH_*.json family: it is the fleet
+    # aggregator's committed artifact and carries the pod-level headline
+    # (goodput fraction, max step skew) this index exists to surface.
+    paths = sorted(glob.glob(os.path.join(_DIR, "BENCH_*.json")))
+    fleet_path = os.path.join(_DIR, "FLEET.json")
+    if os.path.exists(fleet_path):
+        paths.append(fleet_path)
+    for path in paths:
         name = os.path.basename(path)
         if name == os.path.basename(_OUT):
             continue
@@ -115,7 +129,7 @@ def main() -> int:
     report = {
         "schema_version": 1,
         "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "source_glob": "BENCH_*.json",
+        "source_glob": "BENCH_*.json + FLEET.json",
         "artifacts": artifacts,
         "unreadable": unreadable,
     }

@@ -27,11 +27,24 @@ are written atomically only after every check passed. ``--check``
 validates an existing TELEMETRY.json instead of re-measuring (CI /
 test-pin mode).
 
+Since the fleet layer (telemetry_aggregate.py, docs/OBSERVABILITY.md)
+there is a third question: **does aggregation work on a REAL multi-
+process run?** ``measure()`` ends with a fleet rehearsal — an actual
+2-child ``cli launch --independent`` CPU-sim run into one shared
+telemetry dir, aggregated by ``build_fleet`` — and asserts the fleet
+invariants (merged trace valid, pod goodput categories sum exactly to
+aggregate wall, straggler report over common steps, per-process
+histograms merged) before anything is written. The resulting FLEET.json
+is copied to the repo root (committed artifact; ``$DDL_FLEET_OUT``),
+and the aggregation pass's wall time is recorded against the same 2%
+bar (aggregation that costs a meaningful fraction of the run it
+describes would be interference, same principle as the loop overhead).
+
 Usage: python tools/telemetry_report.py            (measure + write)
        python tools/telemetry_report.py --check    (validate committed)
-Env: $DDL_TELEMETRY_OUT / $DDL_TELEMETRY_BENCH_OUT override the output
-paths; $DDL_TELEMETRY_STEPS sets the per-segment step count;
-DDL_TELEMETRY_SHRINK=1 is the CI dry-run (short segments).
+Env: $DDL_TELEMETRY_OUT / $DDL_TELEMETRY_BENCH_OUT / $DDL_FLEET_OUT
+override the output paths; $DDL_TELEMETRY_STEPS sets the per-segment
+step count; DDL_TELEMETRY_SHRINK=1 is the CI dry-run (short segments).
 """
 
 from __future__ import annotations
@@ -73,6 +86,15 @@ _SEG_STEPS = int(os.environ.get(
 _SEGMENTS = 2 if _SHRINK else 7  # disabled/enabled pairs
 _OVERHEAD_BAR = 0.02
 _LEDGER_TOL = 0.01  # categories must sum to wall within 1%
+_FLEET_OUT = os.environ.get(
+    "DDL_FLEET_OUT", os.path.join(_REPO, "FLEET.json")
+)
+_FLEET_STEPS = int(os.environ.get(
+    "DDL_FLEET_STEPS", "12" if _SHRINK else "24"
+))
+# Pod goodput exactness: each per-attempt record commits 6-decimal
+# rounding, so N summed records can drift by N microseconds — never more.
+_FLEET_SUM_TOL = 1e-5
 
 
 def _workload():
@@ -230,6 +252,13 @@ def measure() -> tuple[dict, dict]:
     if problems:
         raise RuntimeError("; ".join(problems))
 
+    # The fleet rehearsal (raises on any violated invariant): a real
+    # 2-child launch, aggregated. Runs LAST so its artifacts only get
+    # written when the single-process story already checked out.
+    print("fleet rehearsal: 2-child cli launch --independent ...",
+          flush=True)
+    fleet, fleet_run = fleet_rehearsal()
+
     utc = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     telemetry_art = {
         "schema": 1,
@@ -258,6 +287,7 @@ def measure() -> tuple[dict, dict]:
             "tolerance": _LEDGER_TOL,
         },
         "registry": tel.registry.to_dict(),
+        "fleet": {**fleet_run, "headline": fleet["headline"]},
         "utc": utc,
     }
     bench_art = {
@@ -267,11 +297,136 @@ def measure() -> tuple[dict, dict]:
         "disabled_steps_per_sec": round(disabled_sps, 4),
         "enabled_steps_per_sec": round(enabled_sps, 4),
         "overhead_fraction": round(overhead, 6),
+        "aggregation_overhead_fraction":
+            fleet_run["aggregation_overhead_fraction"],
+        "pod_goodput_fraction": fleet["headline"]["pod_goodput_fraction"],
+        "max_step_skew_s": fleet["headline"]["max_step_skew_s"],
         "shrunk": _SHRINK,
         "workload": telemetry_art["workload"],
         "utc": utc,
     }
-    return telemetry_art, bench_art
+    return telemetry_art, bench_art, fleet
+
+
+_FLEET_CFG = '''\
+"""Fleet-rehearsal workload (generated by tools/telemetry_report.py)."""
+from distributeddeeplearning_tpu.config import (
+    Config, DataConfig, ModelConfig, OptimConfig, TrainConfig,
+)
+from distributeddeeplearning_tpu.mesh import MeshConfig
+
+
+def get_config() -> Config:
+    return Config(
+        model=ModelConfig(
+            name="gpt2",
+            kwargs={{"size": "tiny", "vocab_size": 256, "max_len": 64,
+                     "dropout_rate": 0.0}},
+        ),
+        data=DataConfig(
+            kind="synthetic_tokens", batch_size=8, seq_len=64,
+            vocab_size=256, seed=0,
+        ),
+        optim=OptimConfig(name="adamw", lr=1e-3),
+        train=TrainConfig(steps={steps}, log_every={log_every}, task="lm"),
+        mesh=MeshConfig(dp=-1),
+    )
+'''
+
+
+def fleet_rehearsal() -> tuple[dict, dict]:
+    """A REAL 2-child ``cli launch --independent`` CPU-sim run into one
+    shared telemetry dir, then the full aggregation pass.
+
+    Returns ``(fleet_record, run_info)`` and raises on any violated
+    fleet invariant — so a broken aggregator can never write artifacts.
+    ``--independent`` because the multiprocess CPU rendezvous needs
+    jax >= 0.5 (docs/MULTISLICE.md); the telemetry-dir sharing, artifact
+    stamping, and clock alignment under test are identical either way."""
+    import subprocess
+
+    from distributeddeeplearning_tpu.telemetry_aggregate import build_fleet
+
+    work = tempfile.mkdtemp(prefix="ddl_fleet_rehearsal_")
+    tdir = os.path.join(work, "telemetry")
+    cfg_path = os.path.join(work, "fleet_cfg.py")
+    with open(cfg_path, "w") as f:
+        f.write(_FLEET_CFG.format(
+            steps=_FLEET_STEPS, log_every=max(_FLEET_STEPS // 4, 1)
+        ))
+    cmd = [
+        sys.executable, "-m", "distributeddeeplearning_tpu.cli", "launch",
+        "--config", cfg_path, "--num-processes", "2",
+        "--devices-per-process", "2", "--independent",
+        "--telemetry", tdir,
+    ]
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    run_wall = time.perf_counter() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fleet launch exited {proc.returncode}:\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    t1 = time.perf_counter()
+    fleet = build_fleet(tdir)
+    agg_wall = time.perf_counter() - t1
+
+    problems: list[str] = []
+    if fleet["processes"] != [0, 1]:
+        problems.append(f"expected processes [0, 1], got {fleet['processes']}")
+    if not fleet["trace"]["valid"] or not fleet["trace"]["events"]:
+        problems.append(
+            f"merged trace invalid/empty: {fleet['trace']['problems']}"
+        )
+    gp = fleet["goodput"]
+    if not gp or gp.get("attempts", 0) < 2:
+        problems.append(f"pod goodput missing/short: {gp}")
+    else:
+        drift = abs(sum(gp["categories"].values()) - gp["wall_s"])
+        if drift > _FLEET_SUM_TOL:
+            problems.append(
+                f"pod categories sum off wall by {drift} > {_FLEET_SUM_TOL}"
+            )
+        if not (0.0 < gp["goodput_fraction"] <= 1.0):
+            problems.append(
+                f"pod goodput_fraction {gp['goodput_fraction']} out of (0,1]"
+            )
+    st = fleet["straggler"]
+    if st["common_steps"] < _FLEET_STEPS:
+        problems.append(
+            f"straggler report covers {st['common_steps']} common steps "
+            f"< {_FLEET_STEPS}"
+        )
+    elif not st["skew_s"] or st["skew_s"]["max"] < 0:
+        problems.append(f"straggler skew malformed: {st['skew_s']}")
+    hist = fleet["histograms"].get("step")
+    if not hist or hist["count"] < 2 * _FLEET_STEPS:
+        problems.append(
+            f"merged step histogram count {hist and hist['count']} < "
+            f"{2 * _FLEET_STEPS} (2 processes x {_FLEET_STEPS} steps)"
+        )
+    agg_frac = agg_wall / run_wall if run_wall else 0.0
+    if agg_frac > _OVERHEAD_BAR:
+        problems.append(
+            f"aggregation wall {agg_wall:.3f}s is {agg_frac:.4f} of the "
+            f"run ({run_wall:.1f}s) > {_OVERHEAD_BAR} bar"
+        )
+    if problems:
+        raise RuntimeError("fleet rehearsal: " + "; ".join(problems))
+    run_info = {
+        "num_processes": 2,
+        "steps_per_process": _FLEET_STEPS,
+        "independent": True,
+        "run_wall_s": round(run_wall, 3),
+        "aggregation_wall_s": round(agg_wall, 4),
+        "aggregation_overhead_fraction": round(agg_frac, 6),
+        "bar": _OVERHEAD_BAR,
+    }
+    return fleet, run_info
 
 
 def check(path: str = _OUT) -> list[str]:
@@ -312,6 +467,52 @@ def check(path: str = _OUT) -> list[str]:
             "no registry executable with non-null positive "
             "argument/output/temp memory_analysis bytes"
         )
+    fl = art.get("fleet") or {}
+    if not isinstance(fl.get("aggregation_overhead_fraction"), (int, float)):
+        problems.append("fleet.aggregation_overhead_fraction missing")
+    elif fl["aggregation_overhead_fraction"] > float(
+        fl.get("bar", _OVERHEAD_BAR)
+    ):
+        problems.append("fleet aggregation overhead exceeds bar")
+    return problems
+
+
+def check_fleet(path: str = _FLEET_OUT) -> list[str]:
+    """Validate a committed FLEET.json (the fleet-rehearsal artifact) —
+    the test-pinned schema + invariants, re-checked without re-running."""
+    problems: list[str] = []
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"unreadable: {type(e).__name__}: {e}"]
+    if art.get("schema_version") != 1:
+        problems.append(f"schema_version {art.get('schema_version')} != 1")
+    if not isinstance(art.get("processes"), list) or len(
+        art.get("processes") or []
+    ) < 2:
+        problems.append("fewer than 2 processes in FLEET.json")
+    tr = art.get("trace") or {}
+    if not tr.get("valid") or not tr.get("events"):
+        problems.append("merged trace not valid/non-empty")
+    gp = art.get("goodput") or {}
+    cats = gp.get("categories") or {}
+    if not cats:
+        problems.append("pod goodput categories missing")
+    elif abs(sum(cats.values()) - float(gp.get("wall_s", 0.0))) \
+            > _FLEET_SUM_TOL:
+        problems.append("pod categories do not sum to aggregate wall")
+    st = art.get("straggler") or {}
+    if not st.get("common_steps"):
+        problems.append("straggler report has no common steps")
+    elif not isinstance((st.get("skew_s") or {}).get("max"), (int, float)):
+        problems.append("straggler skew_s.max missing")
+    hl = art.get("headline") or {}
+    for k in ("pod_goodput_fraction", "max_step_skew_s"):
+        if not isinstance(hl.get(k), (int, float)):
+            problems.append(f"headline.{k} missing")
+    if not art.get("histograms"):
+        problems.append("merged histograms missing")
     return problems
 
 
@@ -326,27 +527,33 @@ def _write(path: str, obj: dict) -> None:
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--check" in argv:
-        problems = check()
+        problems = [f"TELEMETRY: {p}" for p in check()]
+        problems += [f"FLEET: {p}" for p in check_fleet()]
         if problems:
-            print("TELEMETRY.json INVALID:", file=sys.stderr)
+            print("committed telemetry artifacts INVALID:", file=sys.stderr)
             for p in problems:
                 print(f"  - {p}", file=sys.stderr)
             return 1
-        print(f"{_OUT} valid")
+        print(f"{_OUT} and {_FLEET_OUT} valid")
         return 0
     try:
-        telemetry_art, bench_art = measure()
+        telemetry_art, bench_art, fleet = measure()
     except Exception as e:
         # Refuse to clobber committed artifacts with a failed run.
         print(f"measurement FAILED ({type(e).__name__}: {e}); leaving "
-              f"{_OUT} and {_BENCH_OUT} untouched", file=sys.stderr)
+              f"{_OUT}, {_BENCH_OUT} and {_FLEET_OUT} untouched",
+              file=sys.stderr)
         raise
     _write(_OUT, telemetry_art)
     _write(_BENCH_OUT, bench_art)
+    _write(_FLEET_OUT, fleet)
     ov = telemetry_art["overhead"]
-    print(f"wrote {_OUT} and {_BENCH_OUT} (overhead_fraction="
-          f"{ov['overhead_fraction']}, enabled {ov['enabled_steps_per_sec']}"
-          f" vs disabled {ov['disabled_steps_per_sec']} steps/s)")
+    print(f"wrote {_OUT}, {_BENCH_OUT} and {_FLEET_OUT} "
+          f"(overhead_fraction={ov['overhead_fraction']}, "
+          f"enabled {ov['enabled_steps_per_sec']} vs disabled "
+          f"{ov['disabled_steps_per_sec']} steps/s; pod goodput "
+          f"{fleet['headline']['pod_goodput_fraction']}, max step skew "
+          f"{fleet['headline']['max_step_skew_s']}s)")
     return 0
 
 
