@@ -320,14 +320,25 @@ def cmd_serve(cfg: Config, prompts: list[str], max_new_tokens: int,
     (``serving/``; docs/SERVING.md): paged KV cache, AOT prefill/decode,
     requests joining and leaving the decode batch mid-flight. Same byte
     tokenizer contract as ``generate``; the ``serving`` config section
-    sizes the engine. Emits one JSON record with completions, per-request
-    latency metrics, engine stats, and the lifecycle event stream."""
+    sizes the engine (``serving.speculation=ngram:K`` turns on greedy
+    speculative decoding — the stats record then carries the accept-rate
+    block). Emits one JSON record with completions, per-request latency
+    metrics, engine stats, and the lifecycle event stream."""
     import numpy as np
 
     from .serving import Request, ServingEngine, check_serving_composition
 
     # Composition fences FIRST (fail by name before any build/restore).
     check_serving_composition(cfg)
+    if temperature > 0 and getattr(cfg.serving, "speculation", "off") != "off":
+        # The per-request half of the speculation fence would only fire
+        # at ServingEngine.submit, after a build + checkpoint restore —
+        # every cli serve request shares one --temperature, so fail now.
+        raise NotImplementedError(
+            "cli serve --temperature > 0 x serving.speculation: "
+            "speculative serving is greedy-only — drop --temperature or "
+            "set serving.speculation=off"
+        )
     if any(not p for p in prompts):
         raise ValueError("prompt must be non-empty")
     if temperature == 0.0 and (top_k or top_p):

@@ -356,6 +356,16 @@ class ServingConfig:
     # instead of stalling the running batch. 0 = uncapped (admit while
     # lanes + blocks last).
     max_prefills_per_step: int = 0
+    # Speculative decoding on the decode hot loop: "off" or "ngram:K".
+    # "ngram:K" self-drafts up to K tokens per lane per step by n-gram
+    # lookup over the request's own prompt+generated history (no draft
+    # model), verifies all K+1 positions in ONE batched forward over the
+    # paged cache, and accepts the longest greedy-matching prefix —
+    # token-for-token identical to non-speculative greedy. Greedy-only
+    # (sampled requests are fenced at submit); requires K >= 1,
+    # K < block_size, and attn_kernel='reference' (the Pallas kernel is
+    # single-token for now) — all fenced by name at config time.
+    speculation: str = "off"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -96,6 +96,22 @@ def decode_step(model, params, cache, tok):
     return _logits_of(out)[:, -1, :], cache
 
 
+def verify_step(model, params, cache, toks):
+    """THE batched draft-and-verify body (speculative decoding): ``toks``
+    [B, L] — each row's last accepted token followed by L-1 drafted tokens
+    — runs through the SAME bulk-write path as :func:`prefill` (for a
+    paged model, ``transformer.paged_decode_attention``'s L>1 lowering
+    with per-row causal cursor masking), and the argmax at every position
+    comes back as ``greedy`` [B, L] int32: ``greedy[:, i]`` is the model's
+    greedy continuation of the stream ending at ``toks[:, i]``. The host
+    accepts the longest prefix where drafts match (serving/engine.py);
+    L == 1 degenerates to the greedy half of :func:`decode_step`, which is
+    what makes exact greedy token parity a structural property rather than
+    a tolerance."""
+    out, cache = prefill(model, params, cache, toks)
+    return jnp.argmax(_logits_of(out), axis=-1).astype(jnp.int32), cache
+
+
 def logits_at(out, pos):
     """Model output -> [B, V] logits at per-row position ``pos`` [B]
     (traced). The serving engine samples the first token of a RIGHT-padded

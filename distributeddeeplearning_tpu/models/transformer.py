@@ -151,10 +151,17 @@ def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
     (garbage) writes land harmlessly in block 0 and their attention output
     is discarded host-side.
 
-    L == 1 is one decode step; L > 1 is bulk prefill (positions beyond the
-    prompt's real length write pad KV into the row's own reserved pages and
-    are overwritten by real decode tokens later; causal masking hides them
-    from every real query).
+    L == 1 is one decode step; L > 1 is bulk prefill — or, at small L, the
+    serving engine's speculative **verify** forward (L = K+1 tokens per
+    row starting at each row's OWN cursor, ``serving.speculation``): the
+    per-row causal mask ``col <= seq_lens[b] + i`` gives query i exactly
+    the prefix through its own draft token, so all K+1 greedy
+    continuations come out of one call. Positions beyond a prompt's real
+    length (prefill pad) or past a rejected draft write garbage KV into
+    the row's own reserved pages — or the null block, past the
+    reservation — and are overwritten in place by later writes at the
+    same cursor positions before any query can attend them; causal
+    masking hides them within the step that wrote them.
 
     ``kernel`` selects the read path (``serving.attn_kernel``):
     - ``reference``: gather each row's pages into a contiguous
@@ -164,7 +171,10 @@ def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
       IN PLACE via scalar-prefetch page-table indirection (interpret mode
       off-TPU, so parity is tested everywhere). Decode steps (L == 1)
       only: bulk prefill runs once per request and keeps the gather —
-      the hot loop is the per-step decode.
+      the hot loop is the per-step decode. The speculative verify
+      forward is L > 1 every step, so it would silently fall back to the
+      gather here — ``speculation x attn_kernel='pallas'`` is therefore
+      fenced by name at config time until a multi-token kernel lands.
 
     The pool WRITE (scatter at the cursor) is the same XLA
     scatter-at-indices in both modes; only the read side differs.

@@ -9,6 +9,7 @@ from .engine import (  # noqa: F401
     SERVABLE_MODELS,
     ServingEngine,
     check_serving_composition,
+    speculation_k,
 )
 from .quant import (  # noqa: F401
     dequantize_params,
@@ -21,4 +22,5 @@ from .scheduler import (  # noqa: F401
     RequestState,
     Scheduler,
     blocks_for,
+    ngram_draft,
 )

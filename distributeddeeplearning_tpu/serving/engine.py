@@ -33,6 +33,24 @@ top_p ride as [B] operands through the per-row ``generate._filter_logits``
 (0 = off / greedy), and each lane carries its own PRNG key chain
 (``fold_in(seed, request_id)``), so one decode batch can mix greedy and
 sampled requests and a request's tokens do not depend on its batchmates.
+
+With ``serving.speculation='ngram:K'`` a THIRD program joins the pair: a
+**verify** executable that scores K+1 positions per lane in one batched
+forward ([S, K+1] tokens — the pending token plus up to K host-drafted
+continuations from ``scheduler.ngram_draft``). The host accepts the
+longest prefix of drafts matching the per-position greedy argmax (always
+>= 1 token: position 0's argmax IS the plain decode output, so a
+fully-rejected draft degenerates to a normal step), then REWINDS by
+simply not advancing the cursor past the accepted run — the device-side
+KV written for rejected positions is dead by construction, because the
+next step's K+1-token scatter re-covers those positions before any
+attention read, and the host-authoritative ``_lens`` is re-injected
+every call. No block is allocated or freed for drafting: reservations
+already cover the worst case, and draft writes past a row's reservation
+land in the null block (the page table is sized one draft-window wider
+than ``max_seq_len`` so they can never clamp into a live block).
+Greedy-only (sampled requests are fenced at submit), so speculative
+output is token-for-token identical to the non-speculative engine.
 """
 
 from __future__ import annotations
@@ -43,11 +61,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..generate import _filter_logits, logits_at, prefill, decode_step
+from ..generate import (
+    _filter_logits, logits_at, prefill, decode_step, verify_step,
+)
 from ..metrics import serving_event, serving_gauges
-from ..telemetry import NULL_TELEMETRY
+from ..telemetry import NULL_TELEMETRY, SPEC_ACCEPT_HIST
 from .quant import dequantize_params, quantization_error, quantize_params
-from .scheduler import KVBlockPool, Request, RequestState, Scheduler, blocks_for
+from .scheduler import (
+    KVBlockPool, Request, RequestState, Scheduler, blocks_for, ngram_draft,
+)
 
 _POOL_LEAVES = ("pool_key", "pool_value")
 _HOST_LEAVES = ("page_table", "seq_lens")
@@ -58,6 +80,58 @@ _HOST_LEAVES = ("page_table", "seq_lens")
 # streams and batched prefills disagree — generate.uses_bulk_prefill),
 # and pipelined models own their own step program.
 SERVABLE_MODELS = ("gpt2", "llama")
+
+
+def speculation_k(spec: str) -> int:
+    """Parse + validate ``serving.speculation``: ``'off'`` -> 0,
+    ``'ngram:K'`` -> K (>= 1). Shared by the config-time fence and the
+    engine constructor so a directly-built engine fails with the same
+    message as ``check_serving_composition``."""
+    spec = str(spec)
+    if spec == "off":
+        return 0
+    head, _, tail = spec.partition(":")
+    if head != "ngram" or not tail:
+        raise ValueError(
+            f"serving.speculation must be 'off' or 'ngram:K', got {spec!r}"
+        )
+    try:
+        k = int(tail)
+    except ValueError:
+        raise ValueError(
+            f"serving.speculation must be 'off' or 'ngram:K' with integer "
+            f"K, got {spec!r}"
+        ) from None
+    if k < 1:
+        raise ValueError(
+            f"serving.speculation='ngram:{k}': K must be >= 1 (K=0 is "
+            "spelled speculation='off')"
+        )
+    return k
+
+
+def _check_speculation(spec: str, block_size: int, attn_kernel: str) -> int:
+    """The speculation composition fences (by name, config time), shared
+    verbatim by ``check_serving_composition`` and ``ServingEngine``."""
+    k = speculation_k(spec)
+    if k == 0:
+        return 0
+    if k >= block_size:
+        raise NotImplementedError(
+            f"serving.speculation='ngram:{k}' x block_size={block_size}: "
+            "one verify step writes K positions past the row cursor and "
+            "the page table is widened by exactly one draft window, so K "
+            "must stay below block_size — lower K or raise block_size"
+        )
+    if attn_kernel == "pallas":
+        raise NotImplementedError(
+            f"serving.speculation='ngram:{k}' x attn_kernel='pallas': the "
+            "Pallas paged-attention kernel is single-token (L == 1) and "
+            "the batched verify forward needs L = K+1 — until the "
+            "multi-token kernel lands, speculation runs on "
+            "attn_kernel='reference'"
+        )
+    return k
 
 
 def check_serving_composition(cfg) -> None:
@@ -125,6 +199,12 @@ def check_serving_composition(cfg) -> None:
             "serving.max_prefills_per_step must be >= 0 (0 = uncapped), "
             f"got {s.max_prefills_per_step}"
         )
+    # Speculative decoding fences: format, K bounds, and the L>1 kernel
+    # gap. The x-sampling fence is per-REQUEST (temperature lives on the
+    # request, not the config) and fires in ServingEngine.submit.
+    _check_speculation(
+        getattr(s, "speculation", "off"), s.block_size, kernel
+    )
 
 
 class ServingEngine:
@@ -178,7 +258,21 @@ class ServingEngine:
             )
         S, bs = int(cfg.slots), int(cfg.block_size)
         self.slots_n, self.block_size = S, bs
-        self.pages = blocks_for(self.max_seq_len, bs)
+        # Speculative decoding (module docstring): up to K host-drafted
+        # tokens per lane per step, verified in one K+1-position forward.
+        # Fenced here as well as at config time — tests and tools build
+        # engines directly from a ServingConfig.
+        self.spec_k = _check_speculation(
+            getattr(cfg, "speculation", "off"), bs,
+            str(getattr(cfg, "attn_kernel", "reference")),
+        )
+        # The page table is ONE DRAFT WINDOW wider than max_seq_len needs:
+        # a verify step scatters up to spec_k positions past the cursor,
+        # and the widened columns (always null-block 0) absorb those
+        # writes — without the slack, jnp.take_along_axis's clamped OOB
+        # gather would silently redirect an overflowing draft write into
+        # the row's own LAST live block and corrupt accepted KV.
+        self.pages = blocks_for(self.max_seq_len + self.spec_k, bs)
 
         # --- size the pool from the HBM budget --------------------------
         # Bytes per block from a shape-only init probe with num_blocks=1:
@@ -267,8 +361,17 @@ class ServingEngine:
         # --- compiled executables ---------------------------------------
         self._prefill_exe: dict[int, object] = {}  # bucket P -> executable
         self._decode_exe = None
+        self._verify_exe = None
         self.num_compiles = 0
-        self.calls = {"prefill": 0, "decode": 0}
+        self.calls = {"prefill": 0, "decode": 0, "verify": 0}
+        # Speculation yield counters (stats() / serve_bench columns):
+        # drafted = draft tokens offered to verify, draft_hits = drafted
+        # tokens accepted, emitted = tokens emitted by verify steps (hits
+        # + one correction/bonus token per lane per step), lane_steps =
+        # (lane, verify call) pairs — emitted/lane_steps is the mean
+        # accepted-per-step, in [1, K+1].
+        self.spec = {"drafted": 0, "draft_hits": 0, "emitted": 0,
+                     "lane_steps": 0}
         self.step_count = 0
 
     # ------------------------------------------------------------------
@@ -341,6 +444,12 @@ class ServingEngine:
         )
         tok, rng = self._sample_body(logits, rng, temp, tk, tp)
         return tok, rng, cache
+
+    def _verify_fn(self, params, cache, toks):
+        # Greedy-only by construction (the x-sampling fence in submit):
+        # no rng / temperature operands, so a lane's PRNG chain is
+        # untouched by verify steps.
+        return verify_step(self.model, self._dequant(params), cache, toks)
 
     def _compile(self, fn, *args, name: str | None = None,
                  donate_argnums=()):
@@ -418,11 +527,27 @@ class ServingEngine:
             )
         return self._decode_exe
 
+    def _verify_exe_or_compile(self):
+        if self._verify_exe is None:
+            S = self.slots_n
+            cacheS = self._inject(self._cache, self._table, self._lens)
+            self._verify_exe = self._compile(
+                self._verify_fn, self._params, cacheS,
+                np.zeros((S, self.spec_k + 1), np.int32),
+                name="serving_verify",
+                donate_argnums=(1,),  # same in-place pool alias as decode
+            )
+        return self._verify_exe
+
     def warmup(self):
-        """Compile the decode graph and every bucket's prefill graph now,
-        so the serving loop's first requests don't pay compile latency
-        (serve_bench calls this before the timed window)."""
+        """Compile the decode graph, every bucket's prefill graph, and
+        (speculation on) the verify graph now, so the serving loop's first
+        requests don't pay compile latency (serve_bench calls this before
+        the timed window). The compile-count pin: ``len(buckets) + 1``
+        executables, ``+ 2`` with speculation on."""
         self._decode_exe_or_compile()
+        if self.spec_k:
+            self._verify_exe_or_compile()
         for b in self.buckets:
             self._prefill_exe_for(b)
 
@@ -441,6 +566,17 @@ class ServingEngine:
 
     def submit(self, request: Request) -> RequestState:
         self.bucket_of(len(request.prompt))  # fail before enqueueing
+        if self.spec_k and request.temperature > 0:
+            # Per-request half of the speculation fence matrix: accepting
+            # a greedy-matched prefix under stochastic sampling would skew
+            # the sampling distribution (correct rejection sampling over
+            # the draft/target distributions is not built).
+            raise NotImplementedError(
+                "serving.speculation x sampled request (temperature="
+                f"{request.temperature}): speculative serving is "
+                "greedy-only — submit temperature=0 requests or set "
+                "serving.speculation='off'"
+            )
         return self.scheduler.submit(request, self.clock())
 
     def _event(self, name: str, state: RequestState, **fields):
@@ -553,6 +689,13 @@ class ServingEngine:
             # and pool occupancy are the capacity-tuning signals
             # (docs/OBSERVABILITY.md), too noisy to emit per request.
             gauges = self.scheduler.gauges()
+            if self.spec_k and self.spec["drafted"]:
+                # Running draft accept rate: the K-tuning signal
+                # (docs/TUNING.md) — when it sags, K is paying verify
+                # width for tokens that get rejected.
+                gauges["spec_accept_rate"] = round(
+                    self.spec["draft_hits"] / self.spec["drafted"], 4
+                )
             rec = serving_gauges(self.step_count, **gauges)
             self._emit(rec)
             tel.note_event(rec)
@@ -563,6 +706,30 @@ class ServingEngine:
         active = self.scheduler.active
         if not active:
             return not self.scheduler.idle
+        toks = dlens = None
+        if self.spec_k:
+            toks = np.zeros((self.slots_n, self.spec_k + 1), np.int32)
+            toks[:, 0] = self._tok
+            dlens = np.zeros((self.slots_n,), np.int32)
+            for state in active:
+                d = self._draft_for(state)
+                if d:
+                    toks[state.slot, 1:1 + len(d)] = d
+                    dlens[state.slot] = len(d)
+        if dlens is not None and dlens.any():
+            self._verify_batch(active, toks, dlens)
+        else:
+            # Speculation off, or no lane found a draft this step: the
+            # cheap L=1 program (same tokens either way — verify with an
+            # all-empty draft row degenerates to exactly this step).
+            self._decode_batch(active)
+        return not self.scheduler.idle
+
+    def _decode_batch(self, active):
+        """One plain decode call (L=1) for the whole batch: the
+        non-speculative hot path, and the speculative engine's fallback on
+        steps where no lane produced a draft."""
+        tel = self._tel
         cacheS = self._inject(self._cache, self._table, self._lens)
         decode_args = {"step": self.step_count, "batch": len(active)}
         if tel.enabled:
@@ -575,9 +742,14 @@ class ServingEngine:
                 self._params, cacheS, self._tok[:, None], self._rng,
                 self._temp, self._top_k, self._top_p,
             )
+            # Sync INSIDE the span: dispatch is async, and the engine
+            # blocks on the sampled tokens either way — the decode span
+            # must charge for that wait or its histogram (the decode-phase
+            # throughput denominator in serve_bench) flatters L=1 steps
+            # relative to the verify path, which must sync to accept.
+            tok = np.asarray(tok)
         self.calls["decode"] += 1
         self._cache = cacheS
-        tok = np.asarray(tok)
         # np.array (copy): rows must stay writable for the next admission.
         self._rng = np.array(rng, np.uint32)
         now = self.clock()
@@ -589,7 +761,87 @@ class ServingEngine:
             self._lens[slot] += 1
             self._tok[slot] = t
             self._finish_if_done(state, t)
-        return not self.scheduler.idle
+
+    def _draft_for(self, state: RequestState) -> list[int]:
+        """Host-side draft source for one lane (overridable in tests): up
+        to ``spec_k`` tokens by n-gram lookup over the request's own
+        prompt + generated history."""
+        return ngram_draft(
+            state.request.prompt + state.generated, self.spec_k
+        )
+
+    def _verify_batch(self, active, toks, dlens):
+        """One speculative verify call: score all K+1 positions per lane,
+        accept each lane's longest greedy-matching draft prefix plus the
+        correction/bonus token, and REWIND past rejects by simply not
+        advancing the cursor — ``_lens`` is host-authoritative and
+        re-injected every call, so KV written for rejected positions is
+        dead until the next step's own K+1-position scatter overwrites it
+        (the scatter precedes every attention read)."""
+        tel = self._tel
+        cacheS = self._inject(self._cache, self._table, self._lens)
+        decode_args = {
+            "step": self.step_count, "batch": len(active),
+            "speculative": True, "drafted": int(dlens.sum()),
+        }
+        if tel.enabled:
+            decode_args["request_ids"] = [
+                s.request.request_id for s in active
+            ]
+        with tel.span("decode", **decode_args) as sp:
+            greedy, cacheS = self._verify_exe_or_compile()(
+                self._params, cacheS, toks
+            )
+            self.calls["verify"] += 1
+            self._cache = cacheS
+            greedy = np.asarray(greedy)
+            now = self.clock()
+            # Vectorized acceptance: the leading-match run length for
+            # every lane in one [S, K] comparison (cumprod of the match
+            # mask counts leading Trues), and one bulk int conversion —
+            # this loop sits INSIDE the decode span, so per-token python
+            # here would eat the very steps speculation just saved.
+            runs = np.cumprod(
+                toks[:, 1:] == greedy[:, :-1], axis=1
+            ).sum(axis=1)
+            accepted_toks = greedy.tolist()
+            emitted = hits = 0
+            for state in active:
+                slot = state.slot
+                req = state.request
+                # Acceptance is clipped so a lane never emits past
+                # max_new_tokens — which is also what keeps every
+                # ACCEPTED logit's query position inside the lane's block
+                # reservation (draft positions beyond it land in the null
+                # block and can only feed rejected logits).
+                limit = min(
+                    int(dlens[slot]),
+                    req.max_new_tokens - len(state.generated) - 1,
+                )
+                m = min(int(runs[slot]), limit)
+                acc = accepted_toks[slot][:m + 1]
+                # EOS inside an accepted run ends the request THERE, same
+                # as the one-token loop would have.
+                if self.cfg.eos_id >= 0 and self.cfg.eos_id in acc:
+                    acc = acc[: acc.index(self.cfg.eos_id) + 1]
+                state.generated.extend(acc)
+                state.token_times_s.extend([now] * len(acc))
+                self._lens[slot] += len(acc)
+                self._tok[slot] = acc[-1]
+                emitted += len(acc)
+                # All-but-the-correction-token were draft hits; after an
+                # EOS truncation every remaining token was a hit (the
+                # correction token sat past the cut).
+                hits += len(acc) - 1 if len(acc) == m + 1 else len(acc)
+                tel.hist(SPEC_ACCEPT_HIST).record(float(len(acc)))
+                self._finish_if_done(state, acc[-1])
+            # Accepted-length span args: the per-step speculation yield,
+            # next to the device call in the merged trace view.
+            sp.set(accepted=emitted, draft_hits=hits)
+        self.spec["drafted"] += int(dlens.sum())
+        self.spec["draft_hits"] += hits
+        self.spec["emitted"] += emitted
+        self.spec["lane_steps"] += len(active)
 
     def run(self, max_steps: int = 0) -> list[RequestState]:
         """Drain the queue; returns the finished states (submit order)."""
@@ -616,4 +868,17 @@ class ServingEngine:
             "quant": self.quant_report,
             "attn_kernel": self.attn_kernel,
             "max_prefills_per_step": self.max_prefills,
+            "speculation": None if not self.spec_k else {
+                "k": self.spec_k,
+                **self.spec,
+                "verify_calls": self.calls["verify"],
+                "accept_rate": (
+                    round(self.spec["draft_hits"] / self.spec["drafted"], 4)
+                    if self.spec["drafted"] else None
+                ),
+                "mean_accepted_per_step": (
+                    round(self.spec["emitted"] / self.spec["lane_steps"], 4)
+                    if self.spec["lane_steps"] else None
+                ),
+            },
         }

@@ -37,6 +37,48 @@ def blocks_for(tokens: int, block_size: int) -> int:
     return -(-tokens // block_size)
 
 
+def ngram_draft(tokens: list[int], k: int, *, max_ngram: int = 3,
+                min_ngram: int = 1) -> list[int]:
+    """Self-drafting by prompt/history lookup: propose up to ``k``
+    continuation tokens for the stream ``tokens`` by finding an earlier
+    occurrence of the stream's trailing n-gram and copying what followed
+    it. Longest n first (``max_ngram`` down to ``min_ngram``) so a
+    specific context beats a common bigram; among matches of that n, the
+    most recent one with a FULL k-token continuation wins (recent context
+    is the best predictor of what the stream does next) — and if no match
+    has k tokens before end-of-history, the leftmost (longest-window)
+    match is used. Without that fallback a greedy run of one repeated
+    token — the single most draftable stream there is — would always
+    match one position back and draft a single token, capping the whole
+    speedup at 2x. Returns ``[]`` when no n-gram recurs — the engine then
+    runs a plain one-token decode step, so drafting can only add
+    coverage, never block it.
+
+    This is the no-second-model draft source (prompt-lookup decoding):
+    greedy LM output is locally repetitive — copied spans, code idioms,
+    loops — and every correctly-drafted token is one decode step the
+    verify forward amortizes away. Pure Python on purpose: it runs on the
+    host scheduler tick and is unit-testable without a device."""
+    if k < 1:
+        raise ValueError(f"ngram_draft(k={k})")
+    n_toks = len(tokens)
+    for n in range(min(max_ngram, n_toks - 1), min_ngram - 1, -1):
+        suffix = tokens[n_toks - n:]
+        # Scan right-to-left; continuation width n_toks - (s + n) only
+        # GROWS as s moves left, so the first full-window match is the
+        # most recent one, and the last match seen is the widest fallback.
+        # s + n <= n_toks - 1 guarantees >= 1 continuation token exists.
+        best = None
+        for s in range(n_toks - n - 1, -1, -1):
+            if tokens[s:s + n] == suffix:
+                best = s
+                if n_toks - (s + n) >= k:
+                    break
+        if best is not None:
+            return tokens[best + n:best + n + k]
+    return []
+
+
 class KVBlockPool:
     """Free-list allocator over the paged KV pool's physical blocks.
 

@@ -60,6 +60,16 @@ SPAN_NAMES = (
     "prefill", "decode", "schedule",
 )
 
+# Speculative-decoding accept counter (serving/engine.py): tokens
+# emitted per lane per verify step (1 = all drafts rejected, K+1 = all
+# accepted) recorded into a standard LatencyHistogram — the value is a
+# COUNT, not seconds, but the log-bucket encoding holds small integers
+# exactly enough and, unlike a bespoke counter, it merges across fleet
+# processes through the same stats_dict()/aggregate path as every
+# latency SLO, so `cli report` sees fleet-wide accept distributions for
+# free. summary()["mean_s"] is the mean accepted-per-step.
+SPEC_ACCEPT_HIST = "spec_accept"
+
 # Goodput ledger categories. "other" is the computed residual at attempt
 # close, so every attempt record's categories sum exactly to its wall.
 GOODPUT_CATEGORIES = (

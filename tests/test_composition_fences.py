@@ -504,3 +504,58 @@ def test_cli_fences_hierarchy_before_mesh_build():
     )
     with pytest.raises(ValueError, match="comm_hierarchy"):
         build_all(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Serving speculation fence matrix (serving.speculation x kernel/K/sampling)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("speculation,kernel,block_size,err,match", [
+    # the L>1 kernel gap: the Pallas paged kernel is single-token, the
+    # verify forward is K+1 wide — fenced until the multi-token kernel
+    ("ngram:2", "pallas", 8, NotImplementedError, "pallas"),
+    # K bounds: the page table is widened by exactly one draft window
+    ("ngram:4", "reference", 4, NotImplementedError, "block_size"),
+    ("ngram:16", "reference", 16, NotImplementedError, "block_size"),
+    ("ngram:0", "reference", 16, ValueError, "K must be >= 1"),
+    ("ngram:-3", "reference", 16, ValueError, "K must be >= 1"),
+    # format errors, by name
+    ("ngram:", "reference", 16, ValueError, "speculation"),
+    ("ngram:two", "reference", 16, ValueError, "speculation"),
+    ("lookahead:2", "reference", 16, ValueError, "speculation"),
+])
+def test_speculation_fence_matrix(speculation, kernel, block_size, err, match):
+    from distributeddeeplearning_tpu.config import Config, ModelConfig, ServingConfig
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(
+        model=ModelConfig(name="gpt2"),
+        serving=ServingConfig(
+            speculation=speculation, attn_kernel=kernel,
+            block_size=block_size,
+        ),
+    )
+    with pytest.raises(err, match=match):
+        check_serving_composition(cfg)
+
+
+@pytest.mark.parametrize("speculation,kernel,block_size", [
+    ("off", "reference", 16),
+    ("off", "pallas", 16),        # pallas alone is fine
+    ("ngram:3", "reference", 4),  # K < block_size
+    ("ngram:15", "reference", 16),
+    ("ngram:1", "reference", 2),  # smallest legal window
+])
+def test_speculation_legal_pairs_pass(speculation, kernel, block_size):
+    from distributeddeeplearning_tpu.config import Config, ModelConfig, ServingConfig
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(
+        model=ModelConfig(name="gpt2"),
+        serving=ServingConfig(
+            speculation=speculation, attn_kernel=kernel,
+            block_size=block_size,
+        ),
+    )
+    check_serving_composition(cfg)  # must not raise

@@ -11,6 +11,13 @@ throughput at equal-or-better p99 TTFT, zero steady-state recompiles,
 the pallas hot-path row token-identical to the reference row, decode
 donation live, per-phase span latency present in every row — are
 re-asserted whenever the file is present.
+
+PR 13 adds the speculation rows: a ``continuous``/``ngram:K`` row on the
+same adversarial random-byte trace (token parity pinned; accept rate
+reported honestly even when low), and a ``speculation`` block rerunning
+speculative on/off on a repetitive-text trace where the committed
+artifact must show >= 1.25x decode-phase tokens/s. The smoke leg checks
+shape and parity only — 6-request latency ratios are noise.
 """
 
 import json
@@ -45,13 +52,29 @@ def _check_shape(rec, n_requests):
     # paged-attention kernel (interpret mode on CPU)
     kernels = [(r["mode"], r["kernel"]) for r in rec["rows"]]
     assert ("continuous", "pallas") in kernels
-    for row in rec["rows"]:
+    # the speculative row: same adversarial trace, draft-and-verify on
+    specs = [(r["mode"], r["speculation"]) for r in rec["rows"]]
+    assert any(m == "continuous" and s.startswith("ngram:")
+               for m, s in specs)
+    spec_rows = rec["speculation"]["rows"]
+    assert [r["speculation"] for r in spec_rows][:2] == [
+        "off", f"ngram:{rec['speculation']['k']}"
+    ]
+    for row in rec["rows"] + spec_rows:
+        speculative = row["speculation"] != "off"
         assert row["requests"] == n_requests
         assert row["generated_tokens"] > 0
         assert row["tokens_per_sec"] > 0
         assert row["tokens_per_sec_per_chip"] > 0
         assert row["ttft_s"]["p99"] >= row["ttft_s"]["p50"] > 0
-        assert row["inter_token_s"]["p99"] >= row["inter_token_s"]["p50"] > 0
+        # Speculative rows can emit several tokens at one timestamp, so
+        # their inter-token p50 may legitimately be 0.
+        itl_floor = 0 if speculative else None
+        assert row["inter_token_s"]["p99"] >= row["inter_token_s"]["p50"]
+        if itl_floor is None:
+            assert row["inter_token_s"]["p50"] > 0
+        else:
+            assert row["inter_token_s"]["p50"] >= itl_floor
         # TTFT now comes from the streaming log-bucket histogram; the
         # exact sorted-sample order statistics ride along and the two
         # must agree within one bucket's relative width.
@@ -71,12 +94,29 @@ def _check_shape(rec, n_requests):
         # every prompt prefilled once, nothing recompiled after warmup
         assert row["prefill_calls"] == n_requests
         assert row["compiles_after_run"] == row["compiles_warmup"]
+        assert row["decode_tokens_per_sec"] > 0
+        if speculative:
+            assert row["verify_calls"] > 0
+            assert 0.0 <= row["accept_rate"] <= 1.0
+            assert 1.0 <= row["mean_accepted_per_step"]
+        else:
+            assert row["verify_calls"] == 0
+            assert row["accept_rate"] is None
+            assert row["mean_accepted_per_step"] is None
     comp = rec["comparison"]
     assert comp["zero_recompiles_in_steady_state"] is True
     assert comp["hist_percentiles_within_bucket_error"] is True
     # kernel selection changes the read path, never the tokens
     assert comp["pallas_tokens_match_reference"] is True
     assert comp["decode_donation_live"] is True
+    # speculation changes WHEN tokens are produced, never WHICH — even
+    # on the adversarial trace where drafting rarely pays
+    assert comp["speculative_tokens_match_reference"] is True
+    assert 0.0 <= comp["speculative_accept_rate_adversarial"] <= 1.0
+    sc = rec["speculation"]["comparison"]
+    assert sc["spec_tokens_match_non_speculative"] is True
+    assert 0.0 < sc["spec_accept_rate_repetitive"] <= 1.0
+    assert sc["spec_decode_tps_ratio"] > 0
 
 
 def test_serve_bench_smoke(tmp_path):

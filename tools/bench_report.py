@@ -73,9 +73,19 @@ def _headline(rec: dict) -> dict:
     comp = rec.get("comparison")
     if isinstance(comp, dict):
         for k in ("throughput_ratio", "p99_ttft_ratio",
-                  "pallas_tokens_match_reference", "decode_donation_live"):
+                  "pallas_tokens_match_reference", "decode_donation_live",
+                  "speculative_tokens_match_reference"):
             if k in comp:
                 out[k] = comp[k]
+    # Serving speculation block: the draft-and-verify headline — decode
+    # tokens/s speculative over non-speculative on the repetitive trace.
+    spec = rec.get("speculation")
+    if isinstance(spec, dict) and isinstance(spec.get("comparison"), dict):
+        for k in ("spec_decode_tps_ratio",
+                  "spec_tokens_match_non_speculative",
+                  "spec_accept_rate_repetitive"):
+            if k in spec["comparison"]:
+                out[k] = spec["comparison"][k]
     # FLEET.json (tools/telemetry_report.py fleet rehearsal): the pod-level
     # headline the aggregator exists for.
     fh = rec.get("headline")
