@@ -364,20 +364,51 @@ def cmd_serve(cfg: Config, prompts: list[str], max_new_tokens: int,
         updates["mesh"] = None
     if updates:
         model = model.clone(**updates)
-    from .telemetry import Telemetry
+    from .telemetry import Telemetry, resolve_dir
 
-    tel = Telemetry.from_config(cfg)
-    engine = ServingEngine(
-        model, state.params, cfg.serving, seed=seed, telemetry=tel
-    )
-    engine.warmup()
-    for p in prompts:
-        engine.submit(Request(
+    requests = [
+        Request(
             prompt=list(p.encode("utf-8")), max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p,
-        ))
-    finished = engine.run()
-    tel.write_trace()
+        )
+        for p in prompts
+    ]
+    tel_extra = {}
+    if cfg.serving.replicas > 1:
+        # Router tier (serving/router.py; docs/SERVING.md): N engine
+        # replicas behind gauge-driven dispatch. Each replica stamps its
+        # own telemetry bundle (process_index=i) into the shared dir —
+        # the layout telemetry_aggregate.build_fleet merges — so the
+        # single top-level Telemetry is NOT built on this path (its p0
+        # stamp would collide with replica 0's).
+        from .serving import ReplicaRouter
+
+        tdir = resolve_dir(cfg) if cfg.telemetry.enabled else None
+        router = ReplicaRouter(
+            model, state.params, cfg.serving, seed=seed, telemetry_dir=tdir,
+        )
+        router.warmup()
+        for req in requests:
+            router.submit(req)
+        finished = router.run()
+        router.write_trace()
+        stats, events = router.stats(), router.events
+        if tdir:
+            tel_extra["telemetry_dir"] = tdir
+    else:
+        tel = Telemetry.from_config(cfg)
+        engine = ServingEngine(
+            model, state.params, cfg.serving, seed=seed, telemetry=tel
+        )
+        engine.warmup()
+        for req in requests:
+            engine.submit(req)
+        finished = engine.run()
+        tel.write_trace()
+        stats, events = engine.stats(), engine.events
+        if tel.enabled:
+            tel_extra["telemetry"] = tel.registry.to_dict()
+            tel_extra["telemetry_dir"] = tel.dir
     results = []
     for st in finished:
         m = st.metrics()
@@ -389,12 +420,10 @@ def cmd_serve(cfg: Config, prompts: list[str], max_new_tokens: int,
     record = {
         "step": int(state.step),
         "results": results,
-        "stats": engine.stats(),
-        "events": engine.events,
+        "stats": stats,
+        "events": events,
+        **tel_extra,
     }
-    if tel.enabled:
-        record["telemetry"] = tel.registry.to_dict()
-        record["telemetry_dir"] = tel.dir
     print(json.dumps(record))
     return 0
 

@@ -366,6 +366,27 @@ class ServingConfig:
     # K < block_size, and attn_kernel='reference' (the Pallas kernel is
     # single-token for now) — all fenced by name at config time.
     speculation: str = "off"
+    # Engine replication (serving/router.py; docs/SERVING.md router
+    # section): number of identical ServingEngine replicas behind a
+    # ReplicaRouter — in-process on CPU sim, one mesh/device group per
+    # replica on hardware. 1 = a single engine, no router tier.
+    replicas: int = 1
+    # Router dispatch policy: 'least_loaded' scores every live replica
+    # from its freshly-pulled scheduler gauges (queue depth, busy lanes,
+    # pool occupancy) at each dispatch; 'round_robin' rotates blindly.
+    router_policy: str = "least_loaded"
+    # SLO-aware admission shedding at the router: 'off' admits every
+    # request (deadline expiry still drops QUEUED requests engine-side);
+    # 'deadline' refuses a request at the front door — typed
+    # 'request_shed' event, no prefill ever spent — when its estimated
+    # queue-wait + prefill (replica latency-histogram percentiles,
+    # floored by the live oldest_queued_age_s gauge) already overruns
+    # its deadline_s.
+    shed_policy: str = "off"
+    # Which percentile of the replica's observed queue-wait / prefill
+    # latency feeds the shed feasibility estimate. Higher = more
+    # conservative admission = more shedding.
+    shed_percentile: float = 50.0
 
 
 @dataclasses.dataclass(frozen=True)

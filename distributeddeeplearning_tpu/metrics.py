@@ -21,10 +21,23 @@ def event_record(name: str, step: int, **fields) -> dict:
     return {"event": name, "step": step, **fields}
 
 
-# Serving lifecycle events (serving/engine.py) — same record shape as the
-# training loop's events so one stream consumer handles both. "step" is the
-# engine's step counter (one decode iteration), not a training step.
-SERVING_EVENTS = ("request_admitted", "first_token", "request_completed")
+# Serving lifecycle events (serving/engine.py + serving/router.py) — same
+# record shape as the training loop's events so one stream consumer handles
+# both. "step" is the engine's step counter (one decode iteration) for
+# engine events, the router's tick counter for router events.
+#
+# - request_shed: the router's typed SLO rejection — the request was
+#   refused AT ADMISSION (it never reached an engine queue and never
+#   consumed a prefill) because its deadline was already infeasible.
+# - request_rerouted: a quarantined replica's queued (never admitted)
+#   request was re-submitted to a surviving replica.
+# - request_failed: the request was in flight on a replica whose step()
+#   raised — its partial output is lost (queued requests re-route; KV state
+#   of admitted ones dies with the replica).
+SERVING_EVENTS = (
+    "request_admitted", "first_token", "request_completed",
+    "request_shed", "request_rerouted", "request_failed",
+)
 
 
 def serving_event(name: str, step: int, *, request_id: int, **fields) -> dict:

@@ -6,10 +6,17 @@ are split so admission policy is unit-testable without a device.
 """
 
 from .engine import (  # noqa: F401
+    ROUTER_POLICIES,
     SERVABLE_MODELS,
+    SHED_POLICIES,
     ServingEngine,
     check_serving_composition,
     speculation_k,
+)
+from .router import (  # noqa: F401
+    Replica,
+    ReplicaRouter,
+    RequestShed,
 )
 from .quant import (  # noqa: F401
     dequantize_params,

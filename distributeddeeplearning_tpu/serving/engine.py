@@ -81,6 +81,12 @@ _HOST_LEAVES = ("page_table", "seq_lens")
 # and pipelined models own their own step program.
 SERVABLE_MODELS = ("gpt2", "llama")
 
+# Router-tier knob domains (serving/router.py dispatches on these; they
+# live here so the config-time fence and the ReplicaRouter constructor
+# validate against one source without a circular import).
+ROUTER_POLICIES = ("round_robin", "least_loaded")
+SHED_POLICIES = ("off", "deadline")
+
 
 def speculation_k(spec: str) -> int:
     """Parse + validate ``serving.speculation``: ``'off'`` -> 0,
@@ -198,6 +204,32 @@ def check_serving_composition(cfg) -> None:
         raise ValueError(
             "serving.max_prefills_per_step must be >= 0 (0 = uncapped), "
             f"got {s.max_prefills_per_step}"
+        )
+    # Router tier fences (serving/router.py). replicas == 1 means "no
+    # router"; the policy knobs are validated regardless so a typo'd
+    # config fails before it is silently ignored.
+    if getattr(s, "replicas", 1) < 1:
+        raise ValueError(
+            f"serving.replicas must be >= 1, got {s.replicas} — 1 serves "
+            "through a single engine, > 1 fronts N replicas with a "
+            "ReplicaRouter"
+        )
+    policy = getattr(s, "router_policy", "least_loaded")
+    if policy not in ROUTER_POLICIES:
+        raise ValueError(
+            f"serving.router_policy must be one of {ROUTER_POLICIES}, got "
+            f"{policy!r}"
+        )
+    shed = getattr(s, "shed_policy", "off")
+    if shed not in SHED_POLICIES:
+        raise ValueError(
+            f"serving.shed_policy must be one of {SHED_POLICIES}, got "
+            f"{shed!r}"
+        )
+    pct = getattr(s, "shed_percentile", 50.0)
+    if not 0.0 < pct <= 100.0:
+        raise ValueError(
+            f"serving.shed_percentile must be in (0, 100], got {pct}"
         )
     # Speculative decoding fences: format, K bounds, and the L>1 kernel
     # gap. The x-sampling fence is per-REQUEST (temperature lives on the
@@ -373,6 +405,7 @@ class ServingEngine:
         self.spec = {"drafted": 0, "draft_hits": 0, "emitted": 0,
                      "lane_steps": 0}
         self.step_count = 0
+        self.draining = False
 
     # ------------------------------------------------------------------
     # cache plumbing: host arrays in, pool arrays shared across programs
@@ -564,7 +597,29 @@ class ServingEngine:
             f"serving.prompt_buckets entry {self.buckets[-1]}"
         )
 
-    def submit(self, request: Request) -> RequestState:
+    def drain(self) -> None:
+        """Graceful shutdown intake cut (the router's elastic-membership
+        primitive, docs/SERVING.md): everything already accepted — queued
+        AND in-flight — runs to completion exactly as it would have
+        (same programs, same tokens), but every new :meth:`submit` is
+        rejected by name. Once :meth:`run` reaches idle the pool's
+        free list is back to the empty-engine state and the replica can
+        be dropped from membership."""
+        self.draining = True
+
+    def submit(self, request: Request,
+               now: float | None = None) -> RequestState:
+        """Enqueue one request. ``now`` overrides the arrival timestamp:
+        the ReplicaRouter stamps arrivals with ITS clock — the request
+        arrived when it hit the router, not at whatever instant the
+        chosen replica's (possibly skewed, possibly virtual) clock
+        happens to read."""
+        if self.draining:
+            raise RuntimeError(
+                "ServingEngine is draining: in-flight requests run to "
+                "completion but new submissions are rejected — route to "
+                "another replica"
+            )
         self.bucket_of(len(request.prompt))  # fail before enqueueing
         if self.spec_k and request.temperature > 0:
             # Per-request half of the speculation fence matrix: accepting
@@ -577,7 +632,9 @@ class ServingEngine:
                 "greedy-only — submit temperature=0 requests or set "
                 "serving.speculation='off'"
             )
-        return self.scheduler.submit(request, self.clock())
+        return self.scheduler.submit(
+            request, self.clock() if now is None else now
+        )
 
     def _event(self, name: str, state: RequestState, **fields):
         rec = serving_event(
@@ -688,7 +745,7 @@ class ServingEngine:
             # Engine-level gauges at a configurable cadence: queue depth
             # and pool occupancy are the capacity-tuning signals
             # (docs/OBSERVABILITY.md), too noisy to emit per request.
-            gauges = self.scheduler.gauges()
+            gauges = self.scheduler.gauges(self.clock())
             if self.spec_k and self.spec["drafted"]:
                 # Running draft accept rate: the K-tuning signal
                 # (docs/TUNING.md) — when it sags, K is paying verify
@@ -868,6 +925,7 @@ class ServingEngine:
             "quant": self.quant_report,
             "attn_kernel": self.attn_kernel,
             "max_prefills_per_step": self.max_prefills,
+            "draining": self.draining,
             "speculation": None if not self.spec_k else {
                 "k": self.spec_k,
                 **self.spec,

@@ -1,0 +1,434 @@
+"""Multi-replica serving router: the fleet front door over N engines.
+
+A single :class:`~.engine.ServingEngine` is one chip's worth of serving —
+one queue, ``slots`` decode lanes, one compiled program set. The
+:class:`ReplicaRouter` multiplies it: N identical engine replicas
+(in-process on CPU sim; one mesh/device group per replica on hardware)
+behind one admission tier. Three concerns live here and ONLY here — the
+engines stay completely unaware of each other:
+
+- **Dispatch** (``serving.router_policy``): ``least_loaded`` pulls every
+  live replica's ``scheduler.gauges()`` at EVERY dispatch — queue depth,
+  busy lanes, pool occupancy are host-side integers, so reading them per
+  tick costs nothing and the router never acts on a stale
+  ``gauge_every``-cadence snapshot. ``round_robin`` rotates blindly (the
+  baseline the gauges have to beat).
+
+- **SLO-aware admission** (``serving.shed_policy='deadline'``): a request
+  carrying ``deadline_s`` is checked for feasibility AT THE FRONT DOOR —
+  estimated queue wait + prefill on the chosen replica (that replica's
+  ``queue_wait``/``prefill`` latency-histogram percentiles, floored by
+  its live ``oldest_queued_age_s`` gauge, which leads the histograms
+  during a wedge) against the deadline. An infeasible request is shed
+  immediately: a typed ``request_shed`` event plus a typed
+  :class:`RequestShed` raise, and the request NEVER consumes a prefill
+  or a queue slot. Admitting it instead would rot in a queue, get
+  deadline-dropped engine-side anyway, and meanwhile push every request
+  behind it past ITS deadline — shedding is what keeps goodput from
+  collapsing under overload (the 100x rows in BENCH_SERVING.json).
+
+- **Elastic membership**: :meth:`drain` cuts one replica's intake
+  (in-flight and queued work completes token-identically, new
+  submissions route elsewhere) for graceful scale-down; a replica whose
+  ``step()`` RAISES is quarantined — its queued, never-admitted requests
+  re-route to surviving replicas (typed ``request_rerouted``), its
+  in-flight requests are reported lost (typed ``request_failed``; their
+  KV state died with the replica).
+
+Determinism: the router assigns globally-unique request ids and every
+replica runs the same params/seed, so a request's greedy tokens are
+IDENTICAL whichever replica serves it — and identical to a direct
+single-engine run (``generate``-parity transitivity; pinned in
+tests/test_serving_router.py and the bench's router block).
+
+Telemetry: each replica gets its own stamped bundle
+(``process_index=i``) in one shared dir, so
+``telemetry_aggregate.build_fleet`` merges the fleet exactly as it
+merges N training processes — no new aggregation code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ..metrics import event_record, serving_event
+from ..telemetry import NULL_TELEMETRY, Telemetry
+from .engine import ROUTER_POLICIES, SHED_POLICIES, ServingEngine
+from .scheduler import Request, RequestState
+
+
+class RequestShed(RuntimeError):
+    """Typed admission rejection: the request's deadline is infeasible on
+    the least-loaded live replica, so the router refused it before it
+    consumed anything. ``record`` is the emitted ``request_shed`` event
+    (replica index, deadline, the estimate that condemned it)."""
+
+    def __init__(self, message: str, record: dict):
+        super().__init__(message)
+        self.record = record
+
+
+@dataclasses.dataclass
+class Replica:
+    """One engine behind the router, plus its membership state."""
+
+    index: int
+    engine: ServingEngine
+    telemetry: Telemetry
+    draining: bool = False
+    quarantined: bool = False
+    error: str | None = None
+
+    @property
+    def live(self) -> bool:
+        """Eligible for NEW work (still stepped while draining)."""
+        return not (self.draining or self.quarantined)
+
+
+class ReplicaRouter:
+    """Fronts ``cfg.replicas`` identical :class:`ServingEngine` replicas.
+
+    ``submit()`` picks a replica (policy + shed check) and enqueues;
+    ``step()`` ticks every non-quarantined replica once; ``run()`` drains
+    to idle. ``cfg`` is a :class:`~..config.ServingConfig`; ``clock`` is
+    injectable exactly like the engine's. ``telemetry_dir`` (optional)
+    gives every replica a stamped :class:`~..telemetry.Telemetry` bundle
+    in that shared dir — the fleet-merge layout.
+    """
+
+    def __init__(self, model, params, cfg, *, clock=time.monotonic,
+                 seed: int = 0, emit=None, static_batching: bool = False,
+                 telemetry_dir: str | None = None):
+        n = int(getattr(cfg, "replicas", 1))
+        if n < 1:
+            raise ValueError(
+                f"serving.replicas must be >= 1, got {n} — 1 serves "
+                "through a single engine, > 1 fronts N replicas with a "
+                "ReplicaRouter"
+            )
+        if static_batching:
+            raise NotImplementedError(
+                f"serving.replicas={n} x static_batching: the "
+                "static-batching baseline exists to isolate ONE engine's "
+                "continuous-batching delta (tools/serve_bench.py) — a "
+                "router in front would re-mix admission policy into the "
+                "measurement. Benchmark static on a single engine."
+            )
+        self.policy = str(getattr(cfg, "router_policy", "least_loaded"))
+        if self.policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"serving.router_policy must be one of {ROUTER_POLICIES}, "
+                f"got {self.policy!r}"
+            )
+        self.shed_policy = str(getattr(cfg, "shed_policy", "off"))
+        if self.shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"serving.shed_policy must be one of {SHED_POLICIES}, got "
+                f"{self.shed_policy!r}"
+            )
+        self.shed_percentile = float(getattr(cfg, "shed_percentile", 50.0))
+        if not 0.0 < self.shed_percentile <= 100.0:
+            raise ValueError(
+                "serving.shed_percentile must be in (0, 100], got "
+                f"{self.shed_percentile}"
+            )
+        self.cfg = cfg
+        self.clock = clock
+        self.telemetry_dir = telemetry_dir
+        self.events: list[dict] = []
+        self._emit = emit if emit is not None else self.events.append
+        self.replicas: list[Replica] = []
+        for i in range(n):
+            tel = (
+                Telemetry(enabled=True, out_dir=telemetry_dir,
+                          process_index=i)
+                if telemetry_dir is not None else NULL_TELEMETRY
+            )
+            engine = ServingEngine(
+                model, params, cfg, clock=clock, seed=seed, telemetry=tel,
+                # Replica-tagged events into the ROUTER's single ordered
+                # stream — per-engine step counters would interleave
+                # ambiguously without the tag.
+                emit=lambda rec, i=i: self._emit({**rec, "replica": i}),
+            )
+            self.replicas.append(Replica(index=i, engine=engine,
+                                         telemetry=tel))
+        # Globally-unique request ids across replicas: each engine's
+        # scheduler counts from 0, so the router must number requests
+        # BEFORE dispatch or two replicas would mint colliding ids (and
+        # colliding PRNG chains — fold_in(seed, request_id)).
+        self._next_id = 0
+        self._rr = 0  # round-robin cursor
+        self.routes: dict[int, int] = {}  # request_id -> replica index
+        self.shed: list[dict] = []
+        self.failed: list[RequestState] = []
+        self.rerouted = 0
+        self.tick_count = 0
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    def _live(self) -> list[Replica]:
+        return [r for r in self.replicas if r.live]
+
+    def _pick(self, now: float) -> Replica:
+        live = self._live()
+        if not live:
+            raise RuntimeError(
+                "ReplicaRouter has no live replicas (all draining or "
+                "quarantined) — cannot accept new requests"
+            )
+        if self.policy == "round_robin":
+            r = live[self._rr % len(live)]
+            self._rr += 1
+            return r
+        # least_loaded: gauges pulled FRESH at this dispatch. Queue depth
+        # first (each queued request costs a full prefill+decode ahead of
+        # ours), then busy lanes, then pool occupancy (a fuller pool
+        # admits later even when a lane is free); index breaks ties
+        # deterministically.
+        def load(r: Replica):
+            g = r.engine.scheduler.gauges(now)
+            return (g["pending"], g["active"], g["used_blocks"], r.index)
+
+        return min(live, key=load)
+
+    def _admit_estimate(self, replica: Replica, now: float) -> float:
+        """Estimated submit->first-token latency on ``replica``, from its
+        gauges + latency histograms:
+
+        - queue-wait component: the observed queue-wait percentile,
+          floored by the head-of-queue's LIVE age
+          (``oldest_queued_age_s``) — the histograms only learn about a
+          wedge after it clears, the gauge sees it while it is happening;
+        - backlog component: ``pending`` x the prefill percentile — every
+          queued request ahead of this one costs at least one SERIAL
+          prefill on this replica before ours can start, which is the
+          signal that fires during a cold-start burst (100x offered
+          load lands before any queue-wait sample exists);
+        - plus one prefill for the request itself.
+        """
+        g = replica.engine.scheduler.gauges(now)
+        hists = replica.telemetry.hists
+
+        def pct(name: str) -> float:
+            h = hists.get(name)
+            if h is None or not h.count:
+                return 0.0
+            return h.percentile(self.shed_percentile) or 0.0
+
+        queue_wait = max(
+            pct("queue_wait"), float(g.get("oldest_queued_age_s") or 0.0)
+        )
+        prefill = pct("prefill")
+        return queue_wait + g["pending"] * prefill + prefill
+
+    def submit(self, request: Request) -> RequestState:
+        """Route one request: pick a replica, shed if its deadline is
+        infeasible there (typed ``request_shed`` event + :class:`
+        RequestShed` raise — no queue slot, no prefill), else enqueue."""
+        if request.request_id is None:
+            request.request_id = self._next_id
+        self._next_id = max(self._next_id, int(request.request_id)) + 1
+        now = self.clock()
+        replica = self._pick(now)
+        if (self.shed_policy == "deadline"
+                and request.deadline_s is not None):
+            est = self._admit_estimate(replica, now)
+            if now + est > request.deadline_s:
+                rec = serving_event(
+                    "request_shed", self.tick_count,
+                    request_id=request.request_id,
+                    replica=replica.index,
+                    deadline_s=round(float(request.deadline_s), 6),
+                    estimated_first_token_s=round(now + est, 6),
+                    reason="deadline_infeasible",
+                )
+                self._emit(rec)
+                replica.telemetry.note_event(rec)
+                self.shed.append(rec)
+                raise RequestShed(
+                    f"request {request.request_id} shed: estimated first "
+                    f"token at {now + est:.4f}s > deadline "
+                    f"{request.deadline_s:.4f}s on replica "
+                    f"{replica.index}",
+                    rec,
+                )
+        # Arrival stamped with the ROUTER's now: the request arrived when
+        # it hit the router, whatever the replica's clock reads.
+        state = replica.engine.submit(request, now)
+        self.routes[int(request.request_id)] = replica.index
+        return state
+
+    # ------------------------------------------------------------------
+    # stepping + failure handling
+    # ------------------------------------------------------------------
+
+    def step_replica(self, index: int) -> bool:
+        """One engine step on one replica, with quarantine-on-raise.
+        Returns False when that replica is idle (or just died)."""
+        r = self.replicas[index]
+        if r.quarantined:
+            return False
+        try:
+            return r.engine.step()
+        except Exception as exc:  # noqa: BLE001 — any step fault kills it
+            self._quarantine(r, exc)
+            return False
+
+    def step(self) -> bool:
+        """One router tick: step every non-quarantined replica (draining
+        replicas included — they must finish their in-flight work).
+        Returns False when the whole fleet is idle."""
+        self.tick_count += 1
+        busy = False
+        for r in self.replicas:
+            busy = self.step_replica(r.index) or busy
+        return busy
+
+    def _quarantine(self, replica: Replica, exc: Exception) -> None:
+        replica.quarantined = True
+        replica.error = f"{type(exc).__name__}: {exc}"
+        self._emit(event_record(
+            "replica_quarantined", self.tick_count,
+            replica=replica.index, error=replica.error,
+        ))
+        sched = replica.engine.scheduler
+        # In-flight requests die with the replica: their KV lives in its
+        # pool and cannot be recovered. Report each loss, typed.
+        for state in sched.active:
+            state.dropped = True
+            self.failed.append(state)
+            self._emit(serving_event(
+                "request_failed", self.tick_count,
+                request_id=state.request.request_id,
+                replica=replica.index, reason="replica_quarantined",
+            ))
+        # Queued (never admitted) requests lost nothing but time:
+        # re-route them through normal dispatch. No shed re-check — the
+        # front door already accepted them; if the detour blew their
+        # deadline the surviving engine's admit pass drops them there.
+        queued = list(sched.pending)
+        sched.pending.clear()
+        for state in queued:
+            self.rerouted += 1
+            self._emit(serving_event(
+                "request_rerouted", self.tick_count,
+                request_id=state.request.request_id,
+                replica=replica.index, reason="replica_quarantined",
+            ))
+            target = self._pick(self.clock())
+            # Straight into the target's scheduler with the ORIGINAL
+            # arrival time: the detour's queueing is real latency the
+            # request experienced and must stay in its TTFT.
+            target.engine.scheduler.submit(state.request, state.arrival_s)
+            self.routes[int(state.request.request_id)] = target.index
+
+    # ------------------------------------------------------------------
+    # membership
+    # ------------------------------------------------------------------
+
+    def drain(self, index: int) -> None:
+        """Graceful scale-down of one replica: no new work routes to it,
+        accepted work (queued + in-flight) completes token-identically,
+        and once idle its pool is back to the empty-engine state."""
+        r = self.replicas[index]
+        r.draining = True
+        r.engine.drain()
+        self._emit(event_record(
+            "replica_draining", self.tick_count, replica=index,
+        ))
+
+    # ------------------------------------------------------------------
+    # lifecycle + introspection
+    # ------------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """AOT-compile every replica's program set now. The fleet compile
+        pin: ``replicas * (len(buckets) + 1)`` executables, ``+ 2`` per
+        replica with speculation on — and ZERO more in steady state."""
+        for r in self.replicas:
+            r.engine.warmup()
+
+    @property
+    def num_compiles(self) -> int:
+        return sum(r.engine.num_compiles for r in self.replicas)
+
+    @property
+    def idle(self) -> bool:
+        return all(
+            r.quarantined or r.engine.scheduler.idle for r in self.replicas
+        )
+
+    def run(self, max_steps: int = 0) -> list[RequestState]:
+        """Tick until the fleet is idle; returns every finished state
+        fleet-wide in request-id order."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps and steps >= max_steps:
+                break
+        return self.finished()
+
+    def finished(self) -> list[RequestState]:
+        out = []
+        for r in self.replicas:
+            # A quarantined replica's COMPLETED requests were delivered
+            # before it died — they count.
+            out.extend(r.engine.scheduler.finished)
+        return sorted(out, key=lambda s: s.request.request_id)
+
+    def gauges(self) -> list[dict]:
+        """Fresh per-replica gauges (one router-tick snapshot)."""
+        now = self.clock()
+        return [
+            {"replica": r.index, "draining": r.draining,
+             "quarantined": r.quarantined,
+             **(({} if r.quarantined
+                 else r.engine.scheduler.gauges(now)))}
+            for r in self.replicas
+        ]
+
+    def stats(self) -> dict:
+        return {
+            "replicas": len(self.replicas),
+            "router_policy": self.policy,
+            "shed_policy": self.shed_policy,
+            "shed": len(self.shed),
+            "rerouted": self.rerouted,
+            "failed": len(self.failed),
+            "quarantined": [
+                {"replica": r.index, "error": r.error}
+                for r in self.replicas if r.quarantined
+            ],
+            "draining": [
+                r.index for r in self.replicas if r.draining
+            ],
+            "ticks": self.tick_count,
+            "num_compiles": self.num_compiles,
+            "per_replica": [
+                {"replica": r.index, **r.engine.stats()}
+                for r in self.replicas
+            ],
+        }
+
+    def write_trace(self) -> None:
+        """Flush every replica's stamped telemetry artifacts (trace,
+        spans, stats) — the layout ``telemetry_aggregate.build_fleet``
+        merges into FLEET.json."""
+        for r in self.replicas:
+            r.telemetry.write_trace()
+
+    def set_clock(self, clock, per_replica=None) -> None:
+        """Swap the router clock and every replica engine's clock —
+        benches install an offset/virtual clock after warmup so compile
+        time stays outside the timed window. ``per_replica`` (optional,
+        ``fn(index) -> clock``) gives each replica its OWN clock: the
+        virtual-time N-chip simulation in tools/serve_bench.py."""
+        self.clock = clock
+        for r in self.replicas:
+            r.engine.clock = (
+                per_replica(r.index) if per_replica is not None else clock
+            )

@@ -332,13 +332,41 @@ class Scheduler:
             "block_high_water": self.pool.high_water,
         }
 
-    def gauges(self) -> dict:
+    def gauges(self, now: float | None = None) -> dict:
         """The instantaneous capacity gauges (``metrics.serving_gauges``
         kwargs): queue depth + pool occupancy, the subset of :meth:`stats`
-        that changes every engine step and drives admission."""
-        return {
+        that changes every engine step and drives admission.
+
+        With ``now`` (the engine clock), two queue-derived signals ride
+        along so the replica router's shed decision reads gauges instead
+        of walking another engine's queue:
+
+        - ``oldest_queued_age_s`` — how long the HEAD of the FIFO queue
+          has already waited (0.0 when empty). Under head-of-line
+          blocking every later request waits at least this long, so it
+          is a live lower bound on queue wait that leads the latency
+          histograms (which only learn about a wedge after it clears).
+        - ``queued_deadline_headroom_s`` — min over queued requests of
+          ``deadline_s - now`` (None when nothing queued carries a
+          deadline; negative = something is already doomed and will be
+          dropped at the next admit pass).
+        """
+        g = {
             "pending": len(self.pending),
             "active": len(self.active),
             "free_blocks": self.pool.free_blocks,
             "used_blocks": self.pool.used_blocks,
         }
+        if now is not None:
+            g["oldest_queued_age_s"] = (
+                now - self.pending[0].arrival_s if self.pending else 0.0
+            )
+            headrooms = [
+                st.request.deadline_s - now
+                for st in self.pending
+                if st.request.deadline_s is not None
+            ]
+            g["queued_deadline_headroom_s"] = (
+                min(headrooms) if headrooms else None
+            )
+        return g

@@ -559,3 +559,79 @@ def test_speculation_legal_pairs_pass(speculation, kernel, block_size):
         ),
     )
     check_serving_composition(cfg)  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# Replica router fence matrix (serving.replicas x policies x batching)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kwargs,err,match", [
+    # replica count bounds: 0 and negatives name the knob
+    (dict(replicas=0), ValueError, "serving.replicas must be >= 1"),
+    (dict(replicas=-2), ValueError, "serving.replicas must be >= 1"),
+    # policy typos fail by name even at replicas=1 (no silent ignore)
+    (dict(router_policy="fastest"), ValueError, "router_policy"),
+    (dict(replicas=2, router_policy="round-robin"), ValueError,
+     "router_policy"),
+    (dict(shed_policy="lifo"), ValueError, "shed_policy"),
+    (dict(shed_policy="deadline", shed_percentile=0.0), ValueError,
+     "shed_percentile"),
+    (dict(shed_policy="deadline", shed_percentile=101.0), ValueError,
+     "shed_percentile"),
+])
+def test_router_fence_matrix(kwargs, err, match):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(model=ModelConfig(name="gpt2"),
+                 serving=ServingConfig(**kwargs))
+    with pytest.raises(err, match=match):
+        check_serving_composition(cfg)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(replicas=1),
+    dict(replicas=4, router_policy="round_robin"),
+    dict(replicas=2, shed_policy="deadline", shed_percentile=99.0),
+    # router x speculation COMPOSES: each replica drafts/verifies its own
+    # lanes; the compile pin just widens to replicas * (buckets + 2) —
+    # pinned live in tests/test_serving_router.py.
+    dict(replicas=2, speculation="ngram:3"),
+])
+def test_router_legal_compositions_pass(kwargs):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(model=ModelConfig(name="gpt2"),
+                 serving=ServingConfig(**kwargs))
+    check_serving_composition(cfg)  # must not raise
+
+
+def test_router_rejects_static_batching_by_name():
+    # The router exists to keep lanes busy across replicas; static
+    # batching (admission only into an EMPTY engine) defeats the load
+    # gauges the router balances on. Fenced in the ReplicaRouter ctor —
+    # the flag is an engine-constructor argument, not config, so the
+    # config-level check cannot see it.
+    import jax
+
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.config import ServingConfig
+    from distributeddeeplearning_tpu.serving import ReplicaRouter
+
+    model = models.get_model(
+        "gpt2", size="tiny", vocab_size=97, max_len=64,
+    )
+    import numpy as np
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.int32)
+    )["params"]
+    cfg = ServingConfig(slots=2, block_size=4, hbm_budget_mb=8,
+                        max_seq_len=32, prompt_buckets=(8,), replicas=2)
+    with pytest.raises(NotImplementedError, match="static_batching"):
+        ReplicaRouter(model, params, cfg, static_batching=True)

@@ -18,6 +18,13 @@ reported honestly even when low), and a ``speculation`` block rerunning
 speculative on/off on a repetitive-text trace where the committed
 artifact must show >= 1.25x decode-phase tokens/s. The smoke leg checks
 shape and parity only — 6-request latency ratios are noise.
+
+PR 14 adds the ``router`` block: a replicas x offered-load sweep of the
+ReplicaRouter under virtual-time Poisson arrivals. The smoke leg shrinks
+the sweep (DDL_SERVE_REPLICAS/LOADS/ROUTER_N) and checks per-row shape,
+greedy parity, and the per-fleet compile pin; the scale-out RATIOS
+(4-replica goodput >= 3x single at 10x load, 100x shed rate) are pinned
+on the committed full-sweep artifact only.
 """
 
 import json
@@ -117,6 +124,39 @@ def _check_shape(rec, n_requests):
     assert sc["spec_tokens_match_non_speculative"] is True
     assert 0.0 < sc["spec_accept_rate_repetitive"] <= 1.0
     assert sc["spec_decode_tps_ratio"] > 0
+    _check_router_shape(rec)
+
+
+def _check_router_shape(rec):
+    rtr = rec["router"]
+    assert rtr["timebase"].startswith("virtual")
+    assert rtr["slo_s"] > 0
+    rows = rtr["rows"]
+    assert len(rows) == len(rtr["replicas_swept"]) * len(rtr["loads_swept"])
+    for row in rows:
+        assert row["replicas"] in rtr["replicas_swept"]
+        assert row["load_x"] in rtr["loads_swept"]
+        # every request is accounted for: served, shed at admission, or
+        # dropped past-deadline in queue — never silently lost
+        assert (row["served"] + row["shed"] + row["dropped_in_queue"]
+                == row["requests"])
+        assert 0.0 <= row["shed_rate"] <= 1.0
+        assert row["virtual_makespan_s"] > 0
+        if row["served"]:
+            assert row["served_tokens"] > 0
+            assert row["goodput_tokens_per_sec"] > 0
+            assert row["ttft_exact_s"]["p99"] >= row["ttft_exact_s"]["p50"]
+        # routing never changes tokens: every served request is
+        # token-identical to the direct single-engine oracle
+        assert row["tokens_match_reference"] is True
+        # per-fleet AOT pin: replicas * (buckets + decode + verify),
+        # nothing after the run
+        assert (row["compiles_after_run"] == row["compiles_warmup"]
+                == row["compile_pin"])
+        assert row["failed"] == 0
+    comp = rtr["comparison"]
+    assert comp["tokens_match_reference"] is True
+    assert comp["zero_recompiles_per_replica"] is True
 
 
 def test_serve_bench_smoke(tmp_path):
@@ -125,9 +165,16 @@ def test_serve_bench_smoke(tmp_path):
     # in tier-1 time. Latency RATIOS are not asserted here: 6 requests on
     # a shared CI host are noise; the relational claim is pinned on the
     # full-load artifact below.
+    # Router sweep shrunk to one load and two replica counts (8-request
+    # trace): the full router path — dispatch, virtual clocks, shedding,
+    # parity oracle, fleet compile pin — without the committed sweep's
+    # 9-cell cost.
     rec = _run_bench(tmp_path, DDL_SERVE_N="6", DDL_SERVE_RATE="100",
-                     DDL_SERVE_SEED="0")
+                     DDL_SERVE_SEED="0", DDL_SERVE_REPLICAS="1,2",
+                     DDL_SERVE_LOADS="10", DDL_SERVE_ROUTER_N="8")
     _check_shape(rec, 6)
+    assert rec["router"]["replicas_swept"] == [1, 2]
+    assert all(r["requests"] == 8 for r in rec["router"]["rows"])
 
 
 @pytest.mark.slow
@@ -160,3 +207,16 @@ def test_bench_serving_artifact():
     for q in quant_rows:  # optional int8 row
         assert q["quant_report"]["ratio"] < 0.5
         assert q["quant_report"]["max_rel_error"] < 0.05
+    # Router scale-out claims: the acceptance bar for the replica tier.
+    # The committed artifact runs the full 1/2/4 x 1/10/100x sweep, so
+    # the headline ratios must exist AND clear the bar.
+    rcomp = rec["router"]["comparison"]
+    assert rcomp["goodput_ratio_4x_at_10x"] >= 3.0
+    assert rcomp["goodput_ratio_2x_at_10x"] > 1.0
+    # At 100x a lone replica must visibly shed (SLO admission control
+    # working), while the quad still scales.
+    assert rcomp["shed_rate_100x_1_replica"] > 0
+    assert rcomp["goodput_ratio_4x_at_100x"] > 1.0
+    assert rcomp["tokens_match_reference"] is True
+    assert rcomp["zero_recompiles_per_replica"] is True
+    assert rcomp["p99_ttft_bounded_under_shedding"] is True

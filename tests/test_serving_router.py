@@ -1,0 +1,311 @@
+"""Replica router (serving/router.py): token parity across replicas,
+gauge-driven dispatch, SLO shedding, drain, quarantine + re-route, the
+fleet compile pin, and the merged-telemetry layout."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.config import ServingConfig
+from distributeddeeplearning_tpu.serving import (
+    Request,
+    ReplicaRouter,
+    RequestShed,
+    ServingEngine,
+)
+
+_CFG = ServingConfig(
+    slots=3, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+    prompt_buckets=(8, 16), replicas=2,
+)
+
+
+def _model_and_params(seed=7):
+    model = models.get_model("gpt2", size="tiny", vocab_size=97, max_len=64)
+    params = model.init(
+        jax.random.PRNGKey(seed), np.zeros((1, 8), np.int32)
+    )["params"]
+    return model, params
+
+
+def _prompts(lens, seed=42):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(1, 97, n))) for n in lens]
+
+
+def _cell_clock(t0=0.0):
+    """A clock the test advances by hand: ``t[0] = ...``."""
+    t = [t0]
+    return t, (lambda: t[0])
+
+
+def _reference(model, params, prompts, max_new=9):
+    """Direct single-engine run — the parity oracle for every routed
+    request (ids match the router's submission order)."""
+    eng = ServingEngine(model, params, ServingConfig(
+        slots=_CFG.slots, block_size=_CFG.block_size,
+        hbm_budget_mb=_CFG.hbm_budget_mb, max_seq_len=_CFG.max_seq_len,
+        prompt_buckets=_CFG.prompt_buckets,
+    ))
+    for j, p in enumerate(prompts):
+        eng.submit(Request(prompt=list(p), max_new_tokens=max_new,
+                           request_id=j))
+    return {s.request.request_id: list(s.generated) for s in eng.run()}
+
+
+# ---------------------------------------------------------------------------
+# Parity: which replica served a request must never change its tokens
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["round_robin", "least_loaded"])
+def test_router_greedy_parity_across_replicas(policy):
+    # 6 requests spread over 2 replicas: every request's greedy tokens
+    # must equal a direct single-engine run of the same prompts — the
+    # router changes WHERE a request runs, never its numbers (sampling is
+    # keyed per request id, not per slot or replica).
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9, 3, 12, 7, 4))
+    ref = _reference(model, params, prompts)
+    cfg = ServingConfig(**{**vars(_CFG), "router_policy": policy})
+    router = ReplicaRouter(model, params, cfg)
+    for p in prompts:
+        router.submit(Request(prompt=list(p), max_new_tokens=9))
+    done = router.run()
+    assert len(done) == len(prompts)
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    # Both replicas actually served work (the point of the router).
+    assert sorted(set(router.routes.values())) == [0, 1]
+
+
+def test_router_assigns_globally_unique_ids():
+    # Two replicas' schedulers each count from 0 — the router must mint
+    # ids BEFORE dispatch or replicas would collide (and share PRNG
+    # chains, since sampling folds in the request id).
+    model, params = _model_and_params()
+    router = ReplicaRouter(model, params, _CFG)
+    states = [
+        router.submit(Request(prompt=[1, 2, 3], max_new_tokens=3))
+        for _ in range(4)
+    ]
+    ids = [s.request.request_id for s in states]
+    assert len(set(ids)) == 4
+    assert set(router.routes) == set(ids)
+    router.run()
+
+
+# ---------------------------------------------------------------------------
+# Compile pin: replicas x speculation composes, nothing recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_router_fleet_compile_pin_with_speculation():
+    # Each replica AOT-compiles one prefill per bucket + decode + verify
+    # (speculation on): warmup == replicas * (buckets + 2), and serving
+    # adds ZERO compiles — the scale-out axis multiplies executables, it
+    # must never multiply compilation in steady state.
+    model, params = _model_and_params()
+    cfg = ServingConfig(**{**vars(_CFG), "speculation": "ngram:3"})
+    router = ReplicaRouter(model, params, cfg)
+    router.warmup()
+    pin = 2 * (len(_CFG.prompt_buckets) + 2)
+    assert router.num_compiles == pin
+    for p in _prompts((5, 9, 12, 7)):
+        router.submit(Request(prompt=list(p), max_new_tokens=8))
+    router.run()
+    assert router.num_compiles == pin  # steady state: zero recompiles
+
+
+# ---------------------------------------------------------------------------
+# SLO shedding: typed rejection, no prefill spent, no queue slot taken
+# ---------------------------------------------------------------------------
+
+
+def test_shed_is_typed_and_never_consumes_a_prefill():
+    model, params = _model_and_params()
+    t, clock = _cell_clock()
+    cfg = ServingConfig(
+        slots=1, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+        prompt_buckets=(8, 16), replicas=1, shed_policy="deadline",
+    )
+    router = ReplicaRouter(model, params, cfg, clock=clock)
+    # Wedge the single lane: A runs, B queues behind it.
+    router.submit(Request(prompt=[1, 2, 3], max_new_tokens=16))
+    router.submit(Request(prompt=[4, 5, 6], max_new_tokens=16))
+    router.step()  # admits A (one prefill), B still queued
+    eng = router.replicas[0].engine
+    prefills_before = eng.calls["prefill"]
+    t[0] = 5.0  # B's head-of-queue age is now 5s — the live wedge signal
+    with pytest.raises(RequestShed, match="deadline"):
+        router.submit(Request(prompt=[7, 8, 9], max_new_tokens=4,
+                              deadline_s=6.0))  # 1s headroom << 5s wait
+    # Typed event, attributed to the replica that would have served it.
+    (rec,) = router.shed
+    assert rec["event"] == "request_shed"
+    assert rec["reason"] == "deadline_infeasible"
+    assert rec["replica"] == 0
+    assert rec["estimated_first_token_s"] > rec["deadline_s"]
+    # The shed request cost NOTHING: no prefill, no queue entry.
+    assert eng.calls["prefill"] == prefills_before
+    assert len(eng.scheduler.pending) == 1  # just B
+    done = router.run()
+    assert len(done) == 2  # A and B complete; the shed request never ran
+
+
+def test_no_deadline_or_shed_off_always_admits():
+    model, params = _model_and_params()
+    t, clock = _cell_clock()
+    router = ReplicaRouter(model, params, _CFG, clock=clock)  # shed off
+    t[0] = 100.0
+    router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                          deadline_s=0.5))  # hopeless, but shed_policy=off
+    assert not router.shed
+    router.run()
+
+
+# ---------------------------------------------------------------------------
+# Drain: finish in-flight, reject new work by name, leave a clean pool
+# ---------------------------------------------------------------------------
+
+
+def test_engine_drain_completes_inflight_and_rejects_new():
+    # The engine-level contract the router's drain builds on: accepted
+    # requests run to completion TOKEN-IDENTICALLY, submit() fails by
+    # name, and the pool returns to its empty state (every block freed).
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9))
+    ref = _reference(model, params, prompts)
+    eng = ServingEngine(model, params, ServingConfig(
+        slots=3, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+        prompt_buckets=(8, 16),
+    ))
+    for j, p in enumerate(prompts):
+        eng.submit(Request(prompt=list(p), max_new_tokens=9, request_id=j))
+    eng.step()  # work is genuinely in flight when the drain lands
+    eng.drain()
+    with pytest.raises(RuntimeError, match="draining"):
+        eng.submit(Request(prompt=[1, 2], max_new_tokens=2))
+    done = eng.run()
+    assert len(done) == 2
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    assert eng.scheduler.idle
+    assert eng.scheduler.pool.used_blocks == 0
+    # All blocks back on the free list (block 0 is the reserved null).
+    assert eng.scheduler.pool.free_blocks == eng.scheduler.pool.num_blocks - 1
+    assert eng.stats()["draining"] is True
+
+
+def test_router_drain_excludes_replica_from_dispatch():
+    model, params = _model_and_params()
+    router = ReplicaRouter(model, params, _CFG)
+    router.submit(Request(prompt=[1, 2, 3], max_new_tokens=6))
+    router.drain(0)
+    assert [e for e in router.events
+            if e.get("event") == "replica_draining"]
+    for _ in range(3):
+        router.submit(Request(prompt=[4, 5, 6], max_new_tokens=6))
+    # New work all lands on the survivor; the draining replica still
+    # finishes what it had.
+    assert all(v == 1 for k, v in router.routes.items() if k > 0)
+    done = router.run()
+    assert len(done) == 4
+    assert router.replicas[0].engine.scheduler.idle
+
+
+# ---------------------------------------------------------------------------
+# Quarantine: a dead replica's queued work completes on survivors
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_reroutes_queued_requests_to_survivors():
+    model, params = _model_and_params()
+    cfg = ServingConfig(
+        slots=1, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+        prompt_buckets=(8, 16), replicas=2, router_policy="round_robin",
+    )
+    router = ReplicaRouter(model, params, cfg)
+    prompts = _prompts((5, 9, 3, 7))
+    ref = _reference(model, params, prompts)
+
+    def boom():
+        raise RuntimeError("injected step fault")
+
+    # Replica 0 dies on its FIRST step: nothing admitted there yet, so
+    # its whole share (ids 0 and 2, round-robin) is still queued and must
+    # be re-routed, not lost.
+    router.replicas[0].engine.step = boom
+    for j, p in enumerate(prompts):
+        router.submit(Request(prompt=list(p), max_new_tokens=9,
+                              request_id=j))
+    done = router.run()
+    assert len(done) == 4  # every request completed on the survivor
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    stats = router.stats()
+    assert stats["rerouted"] == 2
+    assert stats["failed"] == 0  # nothing was in flight on the dead one
+    assert stats["quarantined"] == [
+        {"replica": 0, "error": "RuntimeError: injected step fault"}
+    ]
+    names = [e.get("event") for e in router.events]
+    assert names.count("replica_quarantined") == 1
+    assert names.count("request_rerouted") == 2
+    # The dead replica is out of the dispatch set from now on.
+    router.submit(Request(prompt=[1, 2], max_new_tokens=2))
+    assert router.routes[max(router.routes)] == 1
+    router.run()
+
+
+def test_quarantine_reports_inflight_as_failed():
+    model, params = _model_and_params()
+    cfg = ServingConfig(
+        slots=1, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+        prompt_buckets=(8, 16), replicas=2, router_policy="round_robin",
+    )
+    router = ReplicaRouter(model, params, cfg)
+    for j in range(2):
+        router.submit(Request(prompt=[1 + j, 2, 3], max_new_tokens=12,
+                              request_id=j))
+    router.step()  # both replicas admit their request (in flight now)
+    real_step = router.replicas[0].engine.step
+
+    def boom():
+        raise RuntimeError("mid-flight fault")
+
+    router.replicas[0].engine.step = boom
+    done = router.run()
+    # Replica 0's in-flight request died with its KV; it is reported as
+    # failed (typed event), NOT silently re-run with a half-built cache.
+    assert [s.request.request_id for s in done] == [1]
+    stats = router.stats()
+    assert stats["failed"] == 1
+    assert any(e.get("event") == "request_failed" for e in router.events)
+    del real_step
+
+
+# ---------------------------------------------------------------------------
+# Fleet telemetry: per-replica bundles merge like a multi-process job
+# ---------------------------------------------------------------------------
+
+
+def test_router_replica_telemetry_merges_into_fleet(tmp_path):
+    from distributeddeeplearning_tpu.telemetry_aggregate import build_fleet
+
+    model, params = _model_and_params()
+    router = ReplicaRouter(model, params, _CFG,
+                           telemetry_dir=str(tmp_path))
+    for p in _prompts((5, 9, 12, 7)):
+        router.submit(Request(prompt=list(p), max_new_tokens=6))
+    router.run()
+    router.write_trace()
+    fleet = build_fleet(str(tmp_path), write=False)
+    # One stamped process per replica, merged by the UNCHANGED fleet
+    # aggregation — replica telemetry is not a new layout.
+    assert fleet["processes"] == [0, 1]
+    hists = fleet["histograms"]
+    assert hists["prefill"]["count"] == 4  # one prefill per request
+    assert hists["ttft"]["count"] == 4

@@ -269,3 +269,42 @@ def test_admit_cap_does_not_break_reservation_guarantee():
     s.complete(a.slot, now=4.0)
     (c,) = s.admit(4.0, _bucket_of, max_admit=1)
     assert sorted(c.blocks) == sorted(blocks_a)  # freed blocks reused
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: gauges (the router's shed-decision inputs)
+# ---------------------------------------------------------------------------
+
+
+def test_gauges_without_now_keeps_original_shape():
+    # Back-compat: the engine's per-step serving_gauges record carries
+    # exactly the four capacity gauges unless a clock is passed.
+    s = _sched()
+    s.submit(_req(), now=0.0)
+    g = s.gauges()
+    assert set(g) == {"pending", "active", "free_blocks", "used_blocks"}
+    assert g["pending"] == 1
+
+
+def test_gauges_oldest_queued_age_tracks_fifo_head():
+    s = _sched(slots=1)
+    assert s.gauges(5.0)["oldest_queued_age_s"] == 0.0  # empty queue
+    s.submit(_req(), now=1.0)
+    s.submit(_req(), now=4.0)
+    # Head-of-line age, not the newest arrival's.
+    assert s.gauges(5.0)["oldest_queued_age_s"] == pytest.approx(4.0)
+    s.admit(5.0, _bucket_of)  # head admitted; the 4.0 arrival is head now
+    assert s.gauges(6.0)["oldest_queued_age_s"] == pytest.approx(2.0)
+
+
+def test_gauges_deadline_headroom_is_min_over_queued():
+    s = _sched(slots=1)
+    g = s.gauges(0.0)
+    assert g["queued_deadline_headroom_s"] is None  # nothing queued
+    s.submit(_req(), now=0.0)  # no deadline: contributes nothing
+    assert s.gauges(1.0)["queued_deadline_headroom_s"] is None
+    s.submit(_req(deadline_s=9.0), now=0.0)
+    s.submit(_req(deadline_s=4.0), now=0.0)
+    assert s.gauges(1.0)["queued_deadline_headroom_s"] == pytest.approx(3.0)
+    # Negative headroom = already doomed (dropped at the next admit pass).
+    assert s.gauges(6.0)["queued_deadline_headroom_s"] == pytest.approx(-2.0)

@@ -23,6 +23,14 @@ Schema (pinned by tests/test_bench_report.py):
 Usage: python tools/bench_report.py   (scans the repo root, or
 $DDL_REPORT_DIR; writes BENCH_TRAJECTORY.json there, or
 $DDL_REPORT_OUT).
+
+``python tools/bench_report.py --check`` validates the COMMITTED
+artifacts this index points at without re-measuring: today that means
+BENCH_SERVING.json's router block (the scale-out + shedding claims) and,
+when BENCH_TRAJECTORY.json exists, that its serving entry actually
+carries the router headline — an index that silently drops the headline
+it was grown to surface is a regression. Exits non-zero listing every
+failure.
 """
 
 from __future__ import annotations
@@ -86,6 +94,15 @@ def _headline(rec: dict) -> dict:
                   "spec_accept_rate_repetitive"):
             if k in spec["comparison"]:
                 out[k] = spec["comparison"][k]
+    # Serving router block: the scale-out headline — fleet goodput at 4
+    # replicas over 1 at 10x offered load, and the overloaded single
+    # replica's typed shed rate at 100x (SLO admission control working).
+    rtr = rec.get("router")
+    if isinstance(rtr, dict) and isinstance(rtr.get("comparison"), dict):
+        for k in ("goodput_ratio_4x_at_10x", "shed_rate_100x_1_replica",
+                  "tokens_match_reference"):
+            if k in rtr["comparison"]:
+                out["router_" + k] = rtr["comparison"][k]
     # FLEET.json (tools/telemetry_report.py fleet rehearsal): the pod-level
     # headline the aggregator exists for.
     fh = rec.get("headline")
@@ -153,5 +170,59 @@ def main() -> int:
     return 0
 
 
+def check() -> int:
+    """Validate the committed router block + the index's serving headline
+    without re-running any engine (the cheap CI gate; see module doc)."""
+    failures = []
+
+    def claim(name, ok):
+        if not ok:
+            failures.append(name)
+
+    serving_path = os.path.join(_DIR, "BENCH_SERVING.json")
+    try:
+        with open(serving_path) as f:
+            serving = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"{serving_path}: unreadable ({type(e).__name__}: {e})")
+        return 1
+    rcomp = serving.get("router", {}).get("comparison", {})
+    claim("router block present", bool(rcomp))
+    claim("goodput_ratio_4x_at_10x >= 3.0",
+          (rcomp.get("goodput_ratio_4x_at_10x") or 0) >= 3.0)
+    claim("shed_rate_100x_1_replica > 0",
+          (rcomp.get("shed_rate_100x_1_replica") or 0) > 0)
+    claim("tokens_match_reference",
+          rcomp.get("tokens_match_reference") is True)
+    claim("zero_recompiles_per_replica",
+          rcomp.get("zero_recompiles_per_replica") is True)
+    claim("p99_ttft_bounded_under_shedding",
+          rcomp.get("p99_ttft_bounded_under_shedding") is True)
+
+    # The index, when committed, must surface the router headline for the
+    # serving artifact (the whole point of indexing it).
+    if os.path.exists(_OUT):
+        with open(_OUT) as f:
+            report = json.load(f)
+        entry = report.get("artifacts", {}).get("BENCH_SERVING.json", {})
+        head = entry.get("headline", {})
+        claim("trajectory carries router_goodput_ratio_4x_at_10x",
+              head.get("router_goodput_ratio_4x_at_10x")
+              == rcomp.get("goodput_ratio_4x_at_10x"))
+        claim("trajectory carries router_shed_rate_100x_1_replica",
+              head.get("router_shed_rate_100x_1_replica")
+              == rcomp.get("shed_rate_100x_1_replica"))
+
+    if failures:
+        print(f"bench_report --check: {len(failures)} claim(s) FAILED:")
+        for name in failures:
+            print(f"  - {name}")
+        return 1
+    print("bench_report --check: all claims hold")
+    return 0
+
+
 if __name__ == "__main__":
+    if "--check" in sys.argv[1:]:
+        sys.exit(check())
     sys.exit(main())
