@@ -366,6 +366,24 @@ class ServingConfig:
     # K < block_size, and attn_kernel='reference' (the Pallas kernel is
     # single-token for now) — all fenced by name at config time.
     speculation: str = "off"
+    # Shared-prefix KV reuse (docs/SERVING.md prefix-cache section): full
+    # KV blocks become immutable and content-addressed in a hash-chained
+    # prefix trie over the block pool. Admission matches the prompt
+    # against the trie, maps cached blocks at refcount+1, and prefills
+    # ONLY the uncached suffix (same compiled bulk-prefill body, started
+    # at the cached offset via the injected seq_lens cursor); refcount-0
+    # blocks are evicted LRU-leaf-first under allocation pressure. Greedy
+    # output is token-for-token identical to a cache-cold engine.
+    prefix_cache: bool = False
+    # Extra prefill widths for the suffix-only path (prefix_cache only):
+    # a short suffix is padded to the smallest fitting width drawn from
+    # suffix_buckets + prompt_buckets, so a 5-token suffix after a long
+    # cached prefix doesn't pay a 512-wide forward. Must be strictly
+    # increasing, disjoint from prompt_buckets, and below the largest
+    # prompt bucket — fenced by name. Compile pin becomes
+    # len(prompt_buckets) + len(suffix_buckets) + 1 (+1 with
+    # speculation), still zero steady-state recompiles.
+    suffix_buckets: tuple = ()
     # Engine replication (serving/router.py; docs/SERVING.md router
     # section): number of identical ServingEngine replicas behind a
     # ReplicaRouter — in-process on CPU sim, one mesh/device group per
@@ -373,7 +391,12 @@ class ServingConfig:
     replicas: int = 1
     # Router dispatch policy: 'least_loaded' scores every live replica
     # from its freshly-pulled scheduler gauges (queue depth, busy lanes,
-    # pool occupancy) at each dispatch; 'round_robin' rotates blindly.
+    # pool occupancy) at each dispatch; 'round_robin' rotates blindly;
+    # 'prefix_affinity' (requires prefix_cache) probes each replica's
+    # prefix-trie digest and sends the request where the most prompt KV
+    # is already cached, tie-breaking on load and falling back to
+    # least-loaded when the affinity target is already a full lane-batch
+    # deeper in queue than the idlest replica (no starvation).
     router_policy: str = "least_loaded"
     # SLO-aware admission shedding at the router: 'off' admits every
     # request (deadline expiry still drops QUEUED requests engine-side);

@@ -81,7 +81,18 @@ def prefill(model, params, cache, tokens):
 
     Shared by :func:`generate`'s fused program and the serving engine's
     per-bucket prefill graphs (serving/engine.py) — one KV/attention body,
-    no serving-side duplicate."""
+    no serving-side duplicate.
+
+    On a paged (``kv_pages``) model this body is OFFSET-CAPABLE with no
+    extra program: absolute positions (gpt2 wpe, llama RoPE), the causal
+    mask, and the KV scatter all derive from the cache's per-row
+    ``seq_lens`` cursor, so running it with ``seq_lens = off`` prefills
+    ``tokens`` as positions ``off .. off+P-1`` against whatever KV the
+    page table already maps below ``off``. The serving engine's prefix
+    cache leans on exactly this: suffix-only prefill is this same
+    executable with a nonzero injected cursor (engine._admit_one), which
+    is why prefix caching adds suffix-width buckets but zero new compiled
+    bodies."""
     out, vars_ = model.apply(
         {"params": params, "cache": cache}, tokens, mutable=["cache"]
     )

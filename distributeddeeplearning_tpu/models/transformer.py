@@ -156,7 +156,14 @@ def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
     row starting at each row's OWN cursor, ``serving.speculation``): the
     per-row causal mask ``col <= seq_lens[b] + i`` gives query i exactly
     the prefix through its own draft token, so all K+1 greedy
-    continuations come out of one call. Positions beyond a prompt's real
+    continuations come out of one call. Because write positions, the
+    mask, and (model-side) absolute positions all chain off ``seq_lens``,
+    bulk prefill at a NONZERO cursor is the prefix cache's suffix-only
+    prefill (``serving.prefix_cache``): the row's table maps shared,
+    published blocks below the cursor — read-only by construction, since
+    every scatter index is ``>= seq_lens[b]`` and cached blocks only
+    cover positions below it — and the suffix attends straight into the
+    shared prefix KV through the same gather. Positions beyond a prompt's real
     length (prefill pad) or past a rejected draft write garbage KV into
     the row's own reserved pages — or the null block, past the
     reservation — and are overwritten in place by later writes at the

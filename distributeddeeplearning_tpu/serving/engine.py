@@ -51,6 +51,25 @@ land in the null block (the page table is sized one draft-window wider
 than ``max_seq_len`` so they can never clamp into a live block).
 Greedy-only (sampled requests are fenced at submit), so speculative
 output is token-for-token identical to the non-speculative engine.
+
+With ``serving.prefix_cache=True`` the pool runs the content-addressed
+prefix trie (``scheduler.KVBlockPool``) and admission becomes
+**suffix-only prefill**: trie-matched blocks are mapped into the page
+table at refcount+1 and the SAME bulk-prefill body runs over just the
+uncached suffix — no new compiled program, because positions, the causal
+mask, and RoPE all derive from the injected ``seq_lens`` leaf, so
+injecting ``seq_lens = cached_len`` instead of 0 starts the prefill at
+the offset (writes land past the cached blocks; the suffix attends to
+cached KV through the shared page table). ``serving.suffix_buckets``
+adds short prefill widths so a 5-token suffix doesn't pay a 512-wide
+executable; the compile pin moves to ``len(prompt_buckets) +
+len(suffix_buckets) + 1`` (+1 with speculation), still with zero
+steady-state recompiles. A FULL-prefix hit (everything but the last
+prompt token cached) skips prefill entirely: the lane is armed with the
+last prompt token as pending input and the first token comes from the
+next batched decode/verify step. Prompt blocks are published into the
+trie right after prefill (their KV is final then); generation-extended
+full blocks are published at completion.
 """
 
 from __future__ import annotations
@@ -84,7 +103,7 @@ SERVABLE_MODELS = ("gpt2", "llama")
 # Router-tier knob domains (serving/router.py dispatches on these; they
 # live here so the config-time fence and the ReplicaRouter constructor
 # validate against one source without a circular import).
-ROUTER_POLICIES = ("round_robin", "least_loaded")
+ROUTER_POLICIES = ("round_robin", "least_loaded", "prefix_affinity")
 SHED_POLICIES = ("off", "deadline")
 
 
@@ -138,6 +157,45 @@ def _check_speculation(spec: str, block_size: int, attn_kernel: str) -> int:
             "attn_kernel='reference'"
         )
     return k
+
+
+def _check_prefix_cache(prefix_cache, suffix_buckets,
+                        prompt_buckets) -> tuple[int, ...]:
+    """The prefix-cache composition fences (by name, config time), shared
+    by ``check_serving_composition`` and ``ServingEngine``. Returns the
+    validated suffix-bucket tuple."""
+    sb = tuple(int(b) for b in (suffix_buckets or ()))
+    if sb and not prefix_cache:
+        raise ValueError(
+            f"serving.suffix_buckets={sb} x prefix_cache=False: suffix "
+            "buckets only shape the suffix-only prefill path — set "
+            "serving.prefix_cache=true or drop them (a silently ignored "
+            "knob is a config bug)"
+        )
+    if not sb:
+        return sb
+    if list(sb) != sorted(set(sb)) or sb[0] < 1:
+        raise ValueError(
+            "serving.suffix_buckets must be strictly increasing positive "
+            f"lengths, got {suffix_buckets!r}"
+        )
+    buckets = tuple(int(b) for b in prompt_buckets)
+    overlap = sorted(set(sb) & set(buckets))
+    if overlap:
+        raise ValueError(
+            f"serving.suffix_buckets {overlap} duplicate prompt_buckets "
+            "entries: that width is already compiled, and the compile pin "
+            "is len(prompt_buckets) + len(suffix_buckets) + 1 — pick "
+            "distinct widths or drop the duplicates"
+        )
+    if sb[-1] >= buckets[-1]:
+        raise ValueError(
+            f"serving.suffix_buckets entry {sb[-1]} is not below the "
+            f"largest prompt bucket {buckets[-1]}: a suffix is always "
+            "shorter than its prompt, so that executable could never be "
+            "selected and would be compiled for nothing"
+        )
+    return sb
 
 
 def check_serving_composition(cfg) -> None:
@@ -220,6 +278,21 @@ def check_serving_composition(cfg) -> None:
             f"serving.router_policy must be one of {ROUTER_POLICIES}, got "
             f"{policy!r}"
         )
+    # Prefix-cache fences: suffix-bucket shape, and the affinity policy's
+    # dependency on the trie digest. prefix_affinity with replicas == 1 is
+    # LEGAL (no router is built; a single replica trivially owns every
+    # prefix), so the policy knob ports unchanged between fleet sizes.
+    prefix_on = bool(getattr(s, "prefix_cache", False))
+    _check_prefix_cache(
+        prefix_on, getattr(s, "suffix_buckets", ()), buckets
+    )
+    if policy == "prefix_affinity" and not prefix_on:
+        raise ValueError(
+            "serving.router_policy='prefix_affinity' x prefix_cache=False: "
+            "affinity scores replicas by their prefix-trie digest, which "
+            "only exists with serving.prefix_cache=true — enable the cache "
+            "or use router_policy='least_loaded'"
+        )
     shed = getattr(s, "shed_policy", "off")
     if shed not in SHED_POLICIES:
         raise ValueError(
@@ -287,6 +360,27 @@ class ServingEngine:
             raise ValueError(
                 f"largest prompt bucket {self.buckets[-1]} leaves no room "
                 f"for generation within max_seq_len {self.max_seq_len}"
+            )
+        # Prefix cache: shared-prefix KV reuse via the pool trie + suffix-
+        # only prefill (module docstring). Suffix buckets are extra prefill
+        # widths; selection falls back to the prompt buckets, so coverage
+        # is guaranteed even with suffix_buckets=().
+        self.prefix_cache = bool(getattr(cfg, "prefix_cache", False))
+        self.suffix_buckets = _check_prefix_cache(
+            self.prefix_cache, getattr(cfg, "suffix_buckets", ()),
+            self.buckets,
+        )
+        self._prefill_widths = tuple(
+            sorted(set(self.buckets) | set(self.suffix_buckets))
+        )
+        if static_batching and self.prefix_cache:
+            raise NotImplementedError(
+                "serving.prefix_cache x static_batching: the static "
+                "baseline exists to isolate continuous batching against a "
+                "fixed per-batch prefill cost, and cross-batch KV reuse "
+                "would confound exactly that comparison — benchmark the "
+                "prefix cache against the cache-off CONTINUOUS engine "
+                "instead (tools/serve_bench.py does)"
             )
         S, bs = int(cfg.slots), int(cfg.block_size)
         self.slots_n, self.block_size = S, bs
@@ -379,7 +473,10 @@ class ServingEngine:
 
         # --- host-side scheduler + per-lane operand rows ----------------
         self.scheduler = Scheduler(
-            S, KVBlockPool(self.num_blocks, bs), self.max_seq_len
+            S,
+            KVBlockPool(self.num_blocks, bs,
+                        prefix_cache=self.prefix_cache),
+            self.max_seq_len,
         )
         self._table = np.zeros((S, self.pages), np.int32)
         self._lens = np.zeros((S,), np.int32)
@@ -573,15 +670,20 @@ class ServingEngine:
         return self._verify_exe
 
     def warmup(self):
-        """Compile the decode graph, every bucket's prefill graph, and
-        (speculation on) the verify graph now, so the serving loop's first
-        requests don't pay compile latency (serve_bench calls this before
-        the timed window). The compile-count pin: ``len(buckets) + 1``
-        executables, ``+ 2`` with speculation on."""
+        """Compile the decode graph, every prefill width (prompt buckets
+        AND suffix buckets — one executable per distinct width, shared
+        ``_prefill_exe`` table), and (speculation on) the verify graph
+        now, so the serving loop's first requests don't pay compile
+        latency (serve_bench calls this before the timed window). The
+        compile-count pin: ``len(prompt_buckets) + len(suffix_buckets) +
+        1`` executables, ``+ 2`` with speculation on — suffix buckets are
+        fenced disjoint from prompt buckets, so the arithmetic is exact
+        and steady-state traffic of any prompt/suffix mix recompiles
+        nothing."""
         self._decode_exe_or_compile()
         if self.spec_k:
             self._verify_exe_or_compile()
-        for b in self.buckets:
+        for b in self._prefill_widths:
             self._prefill_exe_for(b)
 
     # ------------------------------------------------------------------
@@ -596,6 +698,26 @@ class ServingEngine:
             f"prompt length {prompt_len} exceeds the largest "
             f"serving.prompt_buckets entry {self.buckets[-1]}"
         )
+
+    def suffix_bucket_of(self, suffix_len: int) -> int:
+        """Smallest prefill width that fits an uncached suffix — drawn
+        from suffix buckets AND prompt buckets (one executable per
+        distinct width), so a short suffix hits a cheap narrow forward
+        while coverage never regresses below the cold path's."""
+        for b in self._prefill_widths:
+            if suffix_len <= b:
+                return b
+        raise ValueError(
+            f"suffix length {suffix_len} exceeds the largest prefill "
+            f"width {self._prefill_widths[-1]}"
+        )
+
+    def prefix_match_len(self, prompt: list[int]) -> int:
+        """Tokens of ``prompt`` whose KV this replica already caches —
+        the read-only trie digest ``router_policy='prefix_affinity'``
+        scores candidates with (0 with the cache off: affinity then
+        degenerates to least-loaded)."""
+        return self.scheduler.pool.match_len(list(prompt))
 
     def drain(self) -> None:
         """Graceful shutdown intake cut (the router's elastic-membership
@@ -662,25 +784,67 @@ class ServingEngine:
             )
         return done
 
+    def _note_first_token(self, state: RequestState, now: float):
+        """First-token bookkeeping (event + TTFT histogram), shared by the
+        prefill path and the decode/verify paths — a full-prefix cache hit
+        emits its first token from the next BATCHED step, not a prefill."""
+        if state.first_token_s is not None:
+            return
+        state.first_token_s = now
+        self._event(
+            "first_token", state, slot=state.slot,
+            ttft_s=round(now - state.arrival_s, 6),
+        )
+        # SLO feed: TTFT (arrival -> first token, queueing included) into
+        # the mergeable fleet histogram (telemetry.LatencyHistogram) —
+        # what serve_bench and the FLEET.json report read percentiles from.
+        self._tel.hist("ttft").record(now - state.arrival_s)
+
     def _admit_one(self, state: RequestState):
         req, slot = state.request, state.slot
-        P = state.bucket
         row = np.zeros((self.pages,), np.int32)
-        row[: len(state.blocks)] = state.blocks
-        tokens = np.zeros((1, P), np.int32)
-        tokens[0, : len(req.prompt)] = req.prompt  # RIGHT-padded to bucket
+        chain = state.cached_blocks + state.blocks  # logical block order
+        row[: len(chain)] = chain
         rng = np.asarray(
             jax.random.fold_in(
                 jax.random.PRNGKey(self._seed), req.request_id
             ),
             np.uint32,
         )[None]
+        # Arm the sampling operands either way; the rng chain starts at
+        # the same fold_in(seed, request_id) on every admission path, so
+        # tokens are independent of the cache state that admitted them.
+        self._table[slot] = row
+        self._temp[slot] = req.temperature
+        self._top_k[slot] = req.top_k
+        self._top_p[slot] = req.top_p
+        if state.decode_route:
+            # Full-prefix hit: every position but the last prompt token is
+            # cached, and matching is capped there — so there is nothing
+            # to prefill. Arm the lane with the last prompt token as the
+            # pending input; the next batched decode/verify step writes
+            # its KV (position len-1, in the request's OWN first block)
+            # and samples the first new token.
+            self._lens[slot] = len(req.prompt) - 1
+            self._tok[slot] = req.prompt[-1]
+            self._rng[slot] = rng[0]
+            return
+        off = state.cached_len  # 0 = cold, else suffix-only prefill
+        P = state.bucket
+        suffix = req.prompt[off:]
+        tokens = np.zeros((1, P), np.int32)
+        tokens[0, : len(suffix)] = suffix  # RIGHT-padded to the width
         temp = np.float32([req.temperature])
         tk = np.int32([req.top_k])
         tp = np.float32([req.top_p])
-        pos = np.int32([len(req.prompt) - 1])
+        pos = np.int32([len(suffix) - 1])
         exe = self._prefill_exe_for(P)
-        cache1 = self._inject(self._cache, row[None], np.zeros((1,), np.int32))
+        # The SAME bulk-prefill body starts at any offset: positions, the
+        # causal mask, and the KV scatter all derive from the injected
+        # seq_lens leaf, so seq_lens=off shifts everything at once —
+        # writes land in the request's own blocks (row[off//bs:]), and the
+        # suffix attends to cached prefix KV through the shared table.
+        cache1 = self._inject(self._cache, row[None], np.int32([off]))
         tok, rng_out, cache1 = exe(
             self._params, cache1, tokens, pos, rng, temp, tk, tp
         )
@@ -689,25 +853,16 @@ class ServingEngine:
         tok = int(tok[0])
         now = self.clock()
         state.generated.append(tok)
-        state.first_token_s = now
         state.token_times_s.append(now)
         # Arm the lane for decode: the KV holds len real positions (pad
         # writes beyond len are masked and will be overwritten in place).
-        self._table[slot] = row
         self._lens[slot] = len(req.prompt)
         self._tok[slot] = tok
-        self._temp[slot] = req.temperature
-        self._top_k[slot] = req.top_k
-        self._top_p[slot] = req.top_p
         self._rng[slot] = np.asarray(rng_out[0], np.uint32)
-        self._event(
-            "first_token", state, slot=slot,
-            ttft_s=round(now - state.arrival_s, 6),
-        )
-        # SLO feed: TTFT (arrival -> first token, queueing included) into
-        # the mergeable fleet histogram (telemetry.LatencyHistogram) —
-        # what serve_bench and the FLEET.json report read percentiles from.
-        self._tel.hist("ttft").record(now - state.arrival_s)
+        self._note_first_token(state, now)
+        # Publish the prompt's full blocks now that their KV is written
+        # and final — later arrivals in the same wave already hit them.
+        self.scheduler.publish_prefix(state, len(req.prompt))
         self._finish_if_done(state, tok)
 
     def step(self) -> bool:
@@ -720,7 +875,11 @@ class ServingEngine:
             admitted = (
                 [] if self.static_batching and self.scheduler.active
                 else self.scheduler.admit(
-                    now, self.bucket_of, max_admit=self.max_prefills
+                    now, self.bucket_of, max_admit=self.max_prefills,
+                    suffix_bucket_of=(
+                        self.suffix_bucket_of if self.prefix_cache else None
+                    ),
+                    cover_tokens=self.pages * self.block_size,
                 )
             )
             if admitted:
@@ -730,10 +889,19 @@ class ServingEngine:
                 # Perfetto view.
                 sp.set(request_ids=[s.request.request_id for s in admitted])
         for state in admitted:
+            extra = {}
+            if self.prefix_cache:
+                extra["cached_tokens"] = state.cached_len
+                # Prefill tokens the trie absorbed for this admission (0
+                # on a cold miss) — the per-admission distribution behind
+                # the aggregate hit-rate gauge.
+                tel.hist("cached_prefill_skip").record(
+                    float(state.cached_len)
+                )
             self._event(
                 "request_admitted", state, slot=state.slot,
                 bucket=state.bucket, blocks=len(state.blocks),
-                queue_s=round(now - state.arrival_s, 6),
+                queue_s=round(now - state.arrival_s, 6), **extra,
             )
             tel.hist("queue_wait").record(now - state.arrival_s)
             with tel.span(
@@ -817,6 +985,7 @@ class ServingEngine:
             state.token_times_s.append(now)
             self._lens[slot] += 1
             self._tok[slot] = t
+            self._note_first_token(state, now)  # decode-route admissions
             self._finish_if_done(state, t)
 
     def _draft_for(self, state: RequestState) -> list[int]:
@@ -885,6 +1054,7 @@ class ServingEngine:
                 state.token_times_s.extend([now] * len(acc))
                 self._lens[slot] += len(acc)
                 self._tok[slot] = acc[-1]
+                self._note_first_token(state, now)  # decode-route admissions
                 emitted += len(acc)
                 # All-but-the-correction-token were draft hits; after an
                 # EOS truncation every remaining token was a hit (the
@@ -919,6 +1089,7 @@ class ServingEngine:
             "block_bytes": self.block_bytes,
             "pages_per_seq": self.pages,
             "prompt_buckets": list(self.buckets),
+            "suffix_buckets": list(self.suffix_buckets),
             "num_compiles": self.num_compiles,
             "calls": dict(self.calls),
             "steps": self.step_count,

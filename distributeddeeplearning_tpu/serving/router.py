@@ -12,7 +12,20 @@ engines stay completely unaware of each other:
   busy lanes, pool occupancy are host-side integers, so reading them per
   tick costs nothing and the router never acts on a stale
   ``gauge_every``-cadence snapshot. ``round_robin`` rotates blindly (the
-  baseline the gauges have to beat).
+  baseline the gauges have to beat). ``prefix_affinity`` (requires
+  ``serving.prefix_cache``) additionally probes each live replica's
+  prefix-trie digest (``engine.prefix_match_len`` — a read-only hash
+  walk, no refcount or LRU effect) and sends the request where the most
+  prompt KV is already cached: cached tokens are prefill compute the
+  replica never spends, which usually beats a small queue-depth edge
+  elsewhere. Ties break on the least-loaded key, and a STARVATION GUARD
+  caps the bet: when the affinity target's queue is already more than
+  one lane-batch (``slots``) deeper than the idlest replica's, the
+  request falls back to least-loaded — affinity concentrates warm
+  prefixes, it never wedges a replica while others idle. The router
+  itself holds NO affinity state (no prefix->replica map to invalidate):
+  the trie IS the state, it lives replica-side, and it dies with a
+  quarantined replica — re-routed requests simply probe the survivors.
 
 - **SLO-aware admission** (``serving.shed_policy='deadline'``): a request
   carrying ``deadline_s`` is checked for feasibility AT THE FRONT DOOR —
@@ -121,6 +134,15 @@ class ReplicaRouter:
                 f"serving.router_policy must be one of {ROUTER_POLICIES}, "
                 f"got {self.policy!r}"
             )
+        if (self.policy == "prefix_affinity"
+                and not getattr(cfg, "prefix_cache", False)):
+            raise ValueError(
+                "serving.router_policy='prefix_affinity' x "
+                "prefix_cache=False: affinity scores replicas by their "
+                "prefix-trie digest, which only exists with "
+                "serving.prefix_cache=true — enable the cache or use "
+                "router_policy='least_loaded'"
+            )
         self.shed_policy = str(getattr(cfg, "shed_policy", "off"))
         if self.shed_policy not in SHED_POLICIES:
             raise ValueError(
@@ -173,7 +195,8 @@ class ReplicaRouter:
     def _live(self) -> list[Replica]:
         return [r for r in self.replicas if r.live]
 
-    def _pick(self, now: float) -> Replica:
+    def _pick(self, now: float,
+              request: Request | None = None) -> Replica:
         live = self._live()
         if not live:
             raise RuntimeError(
@@ -184,15 +207,42 @@ class ReplicaRouter:
             r = live[self._rr % len(live)]
             self._rr += 1
             return r
-        # least_loaded: gauges pulled FRESH at this dispatch. Queue depth
-        # first (each queued request costs a full prefill+decode ahead of
-        # ours), then busy lanes, then pool occupancy (a fuller pool
-        # admits later even when a lane is free); index breaks ties
+        # least_loaded key: gauges pulled FRESH at this dispatch. Queue
+        # depth first (each queued request costs a full prefill+decode
+        # ahead of ours), then busy lanes, then pool occupancy (a fuller
+        # pool admits later even when a lane is free); index breaks ties
         # deterministically.
-        def load(r: Replica):
-            g = r.engine.scheduler.gauges(now)
-            return (g["pending"], g["active"], g["used_blocks"], r.index)
+        loads = {}
 
+        def load(r: Replica):
+            if r.index not in loads:
+                g = r.engine.scheduler.gauges(now)
+                loads[r.index] = (
+                    g["pending"], g["active"], g["used_blocks"], r.index
+                )
+            return loads[r.index]
+
+        if self.policy == "prefix_affinity" and request is not None:
+            # Probe every live replica's trie digest (read-only hash
+            # walk). Max cached-prefix length wins; among equals the
+            # least-loaded key tie-breaks, so N replicas holding the same
+            # hot prefix still spread its traffic.
+            matches = [
+                (r.engine.prefix_match_len(request.prompt), r)
+                for r in live
+            ]
+            best = max(m for m, _ in matches)
+            if best > 0:
+                choice = min(
+                    (r for m, r in matches if m == best), key=load
+                )
+                # Starvation guard (module docstring): cached-prefix
+                # savings are worth at most one prefill — not a queue
+                # already a full lane-batch deeper than the idlest
+                # replica's.
+                floor = min(load(r)[0] for r in live)
+                if load(choice)[0] - floor <= choice.engine.slots_n:
+                    return choice
         return min(live, key=load)
 
     def _admit_estimate(self, replica: Replica, now: float) -> float:
@@ -233,7 +283,7 @@ class ReplicaRouter:
             request.request_id = self._next_id
         self._next_id = max(self._next_id, int(request.request_id)) + 1
         now = self.clock()
-        replica = self._pick(now)
+        replica = self._pick(now, request)
         if (self.shed_policy == "deadline"
                 and request.deadline_s is not None):
             est = self._admit_estimate(replica, now)
@@ -319,7 +369,9 @@ class ReplicaRouter:
                 request_id=state.request.request_id,
                 replica=replica.index, reason="replica_quarantined",
             ))
-            target = self._pick(self.clock())
+            # Normal dispatch, affinity included: the dead replica's trie
+            # died with it, so the probe only ever sees survivors.
+            target = self._pick(self.clock(), state.request)
             # Straight into the target's scheduler with the ORIGINAL
             # arrival time: the detour's queueing is real latency the
             # request experienced and must stay in its TTFT.
@@ -347,8 +399,9 @@ class ReplicaRouter:
 
     def warmup(self) -> None:
         """AOT-compile every replica's program set now. The fleet compile
-        pin: ``replicas * (len(buckets) + 1)`` executables, ``+ 2`` per
-        replica with speculation on — and ZERO more in steady state."""
+        pin: ``replicas * (len(prompt_buckets) + len(suffix_buckets) +
+        1)`` executables, ``+ 2`` per replica with speculation on — and
+        ZERO more in steady state."""
         for r in self.replicas:
             r.engine.warmup()
 

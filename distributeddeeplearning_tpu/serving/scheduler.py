@@ -13,12 +13,26 @@ Design (docs/SERVING.md):
   blocks of the device-side KV pool. Block 0 is reserved as the NULL block
   (idle decode slots point their whole page table at it), so user blocks
   are ``1..num_blocks-1``. Allocation is all-or-nothing per request.
+- **Prefix cache** (``prefix_cache=True``) — full KV blocks become
+  immutable and content-addressed once *published* into a hash-chained
+  prefix trie: block hash = ``H(parent_hash, block_token_ids)`` (blake2b,
+  so a hash names the whole token prefix up to and including the block,
+  and equal prefixes dedupe regardless of which request wrote them).
+  Cached blocks carry a refcount (live requests mapping the block into
+  their page table) and a logical LRU tick; ``alloc`` evicts refcount-0
+  nodes leaf-first under pressure, so capacity = free list + evictable
+  cache. A block is in exactly one of three states: free, request-owned
+  (``_allocated``), or cached (``_cached``) — conservation over the three
+  is a tested invariant.
 - **Scheduler** — FIFO admission into ``slots`` decode lanes. A queued
   request is admitted when a lane is free AND the pool can hold its whole
   worst-case sequence (prompt bucket + ``max_new_tokens``, rounded up to
-  blocks). Reserving up front means a running request can never hit a
-  mid-flight allocation failure — no preemption machinery in v1, at the
-  cost of conservative occupancy (the tradeoff is documented and the
+  blocks). With the prefix cache on, the reservation counts only the
+  *uncached suffix* blocks — trie-matched blocks are mapped at refcount+1
+  instead of reallocated, so high-hit-rate traffic is not shed on phantom
+  memory pressure. Reserving up front means a running request can never
+  hit a mid-flight allocation failure — no preemption machinery in v1, at
+  the cost of conservative occupancy (the tradeoff is documented and the
   high-water stats expose it).
 - Requests join and leave **mid-flight**: every engine step first retires
   finished lanes (freeing their blocks), then admits from the queue into
@@ -28,6 +42,7 @@ Design (docs/SERVING.md):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 from collections import deque
 
@@ -79,17 +94,60 @@ def ngram_draft(tokens: list[int], k: int, *, max_ngram: int = 3,
     return []
 
 
+_ROOT_HASH = b""  # chain hash of the empty prefix (the trie root)
+
+
+def _block_hash(parent_hash: bytes, tokens) -> bytes:
+    """Chain hash of one full block: ``H(parent_hash, block_token_ids)``.
+
+    blake2b over the parent digest + the block's token ids, so a hash
+    names the entire token prefix ending at this block — two blocks
+    collide only if their whole prefixes match, which is exactly when
+    sharing their KV is correct. A real digest (not Python ``hash``):
+    a silent integer-hash collision would alias one request's KV into
+    another's attention window."""
+    h = hashlib.blake2b(parent_hash, digest_size=16)
+    for t in tokens:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.digest()
+
+
+class _PrefixNode:
+    """One cached (published) block in the prefix trie."""
+
+    __slots__ = ("chain_hash", "parent", "children", "refs", "last_use",
+                 "depth")
+
+    def __init__(self, chain_hash: bytes, parent: int | None, refs: int,
+                 last_use: int, depth: int):
+        self.chain_hash = chain_hash
+        self.parent = parent          # parent block id (None = trie root)
+        self.children: set[int] = set()
+        self.refs = refs              # live requests mapping this block
+        self.last_use = last_use      # logical LRU tick
+        self.depth = depth            # chain length in blocks (1-based)
+
+
 class KVBlockPool:
     """Free-list allocator over the paged KV pool's physical blocks.
 
     ``alloc(n)`` returns a list of n block ids or ``None`` (never partial);
     ``free(ids)`` returns them. Double-free and freeing the null block are
     hard errors — a leak here silently corrupts another request's KV.
-    """
+
+    With ``prefix_cache=True`` the pool additionally runs the
+    content-addressed prefix trie (module docstring): ``match`` finds the
+    longest cached chain for a prompt, ``acquire``/``release`` move its
+    refcounts, ``publish`` turns request-owned full blocks immutable and
+    shareable, and ``alloc`` reclaims refcount-0 cache nodes LRU-leaf-first
+    when the free list alone cannot satisfy a reservation. The LRU clock is
+    a logical tick (bumped on every acquire/publish), not wall time, so
+    eviction order is deterministic and testable."""
 
     NULL_BLOCK = 0
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int, *,
+                 prefix_cache: bool = False):
         if num_blocks < 2:
             raise ValueError(
                 f"KV pool needs >= 2 blocks (1 null + 1 usable), got "
@@ -100,11 +158,18 @@ class KVBlockPool:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.prefix_cache = bool(prefix_cache)
         # LIFO free list: recently-freed (cache-warm) blocks are reused
         # first, and page-table reuse after completion is deterministic.
         self._free = list(range(num_blocks - 1, 0, -1))
         self._allocated: set[int] = set()
         self.high_water = 0
+        # Prefix trie state (empty and inert when prefix_cache is off).
+        self._cached: dict[int, _PrefixNode] = {}   # block id -> node
+        self._by_hash: dict[bytes, int] = {}        # chain hash -> block id
+        self._tick = 0
+        self.evictions = 0
+        self.published_total = 0
 
     @property
     def free_blocks(self) -> int:
@@ -114,14 +179,25 @@ class KVBlockPool:
     def used_blocks(self) -> int:
         return len(self._allocated)
 
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._cached)
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Cache nodes no live request maps (refcount 0) — reclaimable."""
+        return sum(1 for nd in self._cached.values() if nd.refs == 0)
+
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= len(self._free) + self.evictable_blocks
 
     def alloc(self, n: int) -> list[int] | None:
         if n < 1:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if not self.can_alloc(n):
             return None
+        while len(self._free) < n:
+            self._evict_one()
         got = [self._free.pop() for _ in range(n)]
         self._allocated.update(got)
         self.high_water = max(self.high_water, len(self._allocated))
@@ -135,6 +211,166 @@ class KVBlockPool:
                 raise ValueError(f"double/foreign free of block {b}")
             self._allocated.remove(b)
             self._free.append(b)
+
+    # -- prefix trie -------------------------------------------------------
+
+    def match(self, tokens: list[int]) -> list[int]:
+        """Longest cached chain of FULL blocks covering a strict prefix of
+        ``tokens``: the hit is capped at ``(len(tokens) - 1) // block_size``
+        blocks so at least one token is always left to compute (the model
+        must run to sample the next token) and every KV write a request
+        performs lands in its own freshly-allocated blocks — published
+        blocks stay immutable. Read-only: no refcount or LRU effect, so
+        the router can probe replicas' tries for free."""
+        if not self.prefix_cache or not tokens:
+            return []
+        n_full = (len(tokens) - 1) // self.block_size
+        blocks: list[int] = []
+        parent = _ROOT_HASH
+        for k in range(n_full):
+            chunk = tokens[k * self.block_size:(k + 1) * self.block_size]
+            parent = _block_hash(parent, chunk)
+            b = self._by_hash.get(parent)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks
+
+    def match_len(self, tokens: list[int]) -> int:
+        """Tokens of ``tokens`` whose KV is already cached (the replica
+        trie digest ``prefix_affinity`` routing scores against)."""
+        return len(self.match(tokens)) * self.block_size
+
+    def acquire(self, blocks: list[int]) -> None:
+        """Map cached blocks into a request: refcount+1 and LRU-touch the
+        whole chain (one shared tick — a parent is never staler than its
+        children, which is what makes plain LRU leaf-first)."""
+        if not blocks:
+            return
+        self._tick += 1
+        for b in blocks:
+            nd = self._cached[b]
+            nd.refs += 1
+            nd.last_use = self._tick
+
+    def release(self, blocks: list[int]) -> None:
+        """Drop a request's refcounts. Refcount-0 nodes stay cached (warm)
+        until eviction pressure reclaims them."""
+        for b in blocks:
+            nd = self._cached.get(b)
+            if nd is None:
+                raise ValueError(f"releasing uncached block {b}")
+            if nd.refs < 1:
+                raise ValueError(f"refcount underflow on cached block {b}")
+            nd.refs -= 1
+
+    def publish(self, tokens: list[int], blocks: list[int], *,
+                refs: int) -> list[int]:
+        """Publish full blocks into the trie: ``blocks[k]`` holds the KV of
+        ``tokens[k*bs:(k+1)*bs]``. Walks the chain from the root; blocks
+        already in the trie are skipped, a block whose content hash is
+        already cached under a DIFFERENT block id stays request-owned (the
+        existing copy wins; ours is freed normally at completion), and
+        newly published blocks move from ``_allocated`` to the cache at
+        refcount ``refs`` (1 when the publishing request keeps using them,
+        0 at completion). Returns the newly published block ids."""
+        if not self.prefix_cache:
+            return []
+        if len(blocks) * self.block_size > len(tokens):
+            raise ValueError("publish: blocks cover more tokens than given")
+        self._tick += 1
+        published: list[int] = []
+        parent_hash = _ROOT_HASH
+        parent_block: int | None = None
+        for k, b in enumerate(blocks):
+            chunk = tokens[k * self.block_size:(k + 1) * self.block_size]
+            parent_hash = _block_hash(parent_hash, chunk)
+            existing = self._by_hash.get(parent_hash)
+            if existing is not None:
+                # Already cached (possibly by us, possibly a duplicate in
+                # another block) — the chain continues through the cached
+                # copy either way.
+                self._cached[existing].last_use = self._tick
+                parent_block = existing
+                continue
+            if b not in self._allocated:
+                raise ValueError(f"publishing unowned block {b}")
+            self._allocated.remove(b)
+            nd = _PrefixNode(parent_hash, parent_block, refs, self._tick,
+                             depth=k + 1)
+            self._cached[b] = nd
+            self._by_hash[parent_hash] = b
+            if parent_block is not None:
+                self._cached[parent_block].children.add(b)
+            published.append(b)
+            self.published_total += 1
+            parent_block = b
+        return published
+
+    def _drop_node(self, b: int) -> None:
+        """Remove one childless cache node and return its block to the
+        free list."""
+        nd = self._cached.pop(b)
+        if nd.children:
+            raise ValueError(f"dropping cache node {b} with children")
+        del self._by_hash[nd.chain_hash]
+        if nd.parent is not None:
+            self._cached[nd.parent].children.discard(b)
+        self._free.append(b)
+        self.evictions += 1
+
+    def evict_subtree(self, b: int) -> list[int]:
+        """Evict cache node ``b`` AND its whole subtree (deepest first), so
+        an interior eviction detaches its children's hash chain instead of
+        orphaning unreachable nodes. Every node in the subtree must be
+        refcount-0 — a refcount>0 descendant means a live request still
+        maps it, and evicting it would hand its KV to the free list while
+        decode is writing around it. Returns the freed block ids."""
+        stack, order = [b], []
+        while stack:
+            cur = stack.pop()
+            nd = self._cached.get(cur)
+            if nd is None:
+                raise ValueError(f"evicting uncached block {cur}")
+            if nd.refs:
+                raise ValueError(
+                    f"evicting cached block {cur} with refcount {nd.refs}"
+                )
+            order.append(cur)
+            stack.extend(nd.children)
+        for cur in reversed(order):  # children before parents
+            self._drop_node(cur)
+        return order
+
+    def _evict_one(self) -> None:
+        """Reclaim the LRU refcount-0 LEAF. One always exists when
+        ``evictable_blocks > 0``: a request acquires/publishes whole
+        chains from the root, so a refcount>0 child implies a refcount>0
+        parent — the refcount-0 set is closed under descendants and its
+        deepest members are trie leaves. Ties break on block id, so the
+        order is fully deterministic under the logical clock."""
+        best = None
+        for b, nd in self._cached.items():
+            if nd.refs == 0 and not nd.children:
+                key = (nd.last_use, b)
+                if best is None or key < best:
+                    best = key
+        if best is None:
+            raise RuntimeError(
+                "eviction requested with no refcount-0 leaf — refcount "
+                "chain invariant violated"
+            )
+        self._drop_node(best[1])
+
+    def flush_cache(self) -> int:
+        """Evict every refcount-0 cache node (leaf-first); returns the
+        count. With no live requests this empties the trie entirely — the
+        leak check's end state."""
+        n = 0
+        while self.evictable_blocks:
+            self._evict_one()
+            n += 1
+        return n
 
 
 @dataclasses.dataclass
@@ -159,8 +395,18 @@ class RequestState:
 
     request: Request
     arrival_s: float
-    bucket: int = 0  # prompt bucket P chosen at admission
+    bucket: int = 0  # prefill width chosen at admission (0 = decode route)
     blocks: list[int] = dataclasses.field(default_factory=list)
+    # Prefix-cache bookkeeping (all empty/0 with the cache off): trie
+    # blocks mapped at admission (refcount held, released at completion),
+    # the token count they cover, blocks WE own that were published into
+    # the trie mid-flight (released, not freed, at completion), and
+    # whether the hit covered all but the last prompt token (no prefill —
+    # the first token comes from the plain decode step).
+    cached_blocks: list[int] = dataclasses.field(default_factory=list)
+    cached_len: int = 0
+    published: list[int] = dataclasses.field(default_factory=list)
+    decode_route: bool = False
     slot: int = -1
     generated: list[int] = dataclasses.field(default_factory=list)
     admit_s: float | None = None
@@ -219,6 +465,12 @@ class Scheduler:
         self.dropped: list[RequestState] = []
         self._ids = itertools.count()
         self.admitted_total = 0
+        # Prefix-cache counters (stay 0 with the cache off): prompt tokens
+        # served from the trie vs prefilled, and full-prefix admissions
+        # that skipped prefill entirely.
+        self.prefix_hit_tokens = 0
+        self.prefix_miss_tokens = 0
+        self.decode_route_admits = 0
 
     # -- intake ------------------------------------------------------------
 
@@ -248,8 +500,9 @@ class Scheduler:
         except ValueError:
             return -1
 
-    def admit(self, now: float, bucket_of,
-              max_admit: int = 0) -> list[RequestState]:
+    def admit(self, now: float, bucket_of, max_admit: int = 0,
+              suffix_bucket_of=None,
+              cover_tokens: int = 0) -> list[RequestState]:
         """FIFO-admit queued requests while a lane + blocks are available.
         ``bucket_of(prompt_len) -> P`` supplies the engine's prompt bucket
         (block reservation must cover the BUCKET: bulk prefill writes pad
@@ -263,8 +516,22 @@ class Scheduler:
         the running batch's next decode step, so a queue burst at high
         occupancy would otherwise stall in-flight decodes behind
         back-to-back prefills. Capped admissions stay FIFO; the remainder
-        is admitted on subsequent steps, interleaved between decodes."""
+        is admitted on subsequent steps, interleaved between decodes.
+
+        With the prefix cache on the engine passes
+        ``suffix_bucket_of(suffix_len) -> P_s`` (the suffix prefill width)
+        and ``cover_tokens`` (the page-table row's token capacity). The
+        prompt is matched against the trie, matched blocks are acquired at
+        refcount+1, and the reservation counts only the uncached suffix:
+        ``blocks_for(max(cached_len + P_s, prompt + max_new)) - hit`` —
+        always >= 1 because a hit never covers the last prompt token. The
+        hit is trimmed while ``cached_len + P_s`` would overrun the row
+        (a bucket-size overshoot past the last page writes pad KV through
+        a CLAMPED table index — real corruption, not null-block spill).
+        A full-prefix hit (suffix of one token) takes the decode route:
+        no prefill width, first token from the next decode step."""
         placed = []
+        bs = self.pool.block_size
         while self.pending:
             if max_admit and len(placed) >= max_admit:
                 break
@@ -279,23 +546,74 @@ class Scheduler:
             slot = self.free_slot()
             if slot < 0:
                 break
-            bucket = bucket_of(len(req.prompt))
-            need = blocks_for(
-                max(bucket, len(req.prompt) + req.max_new_tokens),
-                self.pool.block_size,
+            plen = len(req.prompt)
+            cached = (
+                self.pool.match(req.prompt)
+                if suffix_bucket_of is not None else []
             )
+            cached_len = len(cached) * bs
+            decode_route = False
+            if cached and plen - cached_len == 1:
+                decode_route = True
+                bucket = 0
+                cover = plen
+            elif cached:
+                bucket = suffix_bucket_of(plen - cached_len)
+                while cached and cached_len + bucket > cover_tokens:
+                    cached.pop()
+                    cached_len -= bs
+                    bucket = (bucket_of(plen) if not cached
+                              else suffix_bucket_of(plen - cached_len))
+                cover = cached_len + bucket
+            else:
+                bucket = bucket_of(plen)
+                cover = bucket
+            need = blocks_for(
+                max(cover, plen + req.max_new_tokens), bs
+            ) - len(cached)
+            # Acquire BEFORE alloc: alloc may evict refcount-0 trie nodes,
+            # and the matched chain must survive it.
+            self.pool.acquire(cached)
             blocks = self.pool.alloc(need)
             if blocks is None:
+                self.pool.release(cached)
                 break
             self.pending.popleft()
             state.bucket = bucket
             state.blocks = blocks
+            state.cached_blocks = cached
+            state.cached_len = cached_len
+            state.decode_route = decode_route
             state.slot = slot
             state.admit_s = now
             self.slots[slot] = state
             self.admitted_total += 1
+            if self.pool.prefix_cache:
+                self.prefix_hit_tokens += cached_len
+                self.prefix_miss_tokens += plen - cached_len
+                self.decode_route_admits += int(decode_route)
             placed.append(state)
         return placed
+
+    def publish_prefix(self, state: RequestState, n_tokens: int) -> int:
+        """Publish ``state``'s first ``n_tokens // block_size`` full blocks
+        into the trie at refcount 1 (the request keeps decoding over them)
+        — the engine calls this right after prefill, when their KV is
+        written and final, so later arrivals in the same wave already hit.
+        Newly published blocks move to ``state.published`` (released, not
+        freed, at completion). Returns the number published."""
+        if not self.pool.prefix_cache:
+            return 0
+        bs = self.pool.block_size
+        chain = state.cached_blocks + state.blocks
+        n_full = min(n_tokens // bs, len(chain))
+        if n_full <= 0:
+            return 0
+        got = self.pool.publish(
+            state.request.prompt[:n_full * bs], chain[:n_full], refs=1
+        )
+        state.published.extend(got)
+        return len(got)
 
     # -- retirement --------------------------------------------------------
 
@@ -304,7 +622,29 @@ class Scheduler:
         if state is None:
             raise ValueError(f"slot {slot} is empty")
         state.finish_s = now
-        self.pool.free(state.blocks)
+        if self.pool.prefix_cache:
+            # Publish the finished sequence's full blocks at refcount 0
+            # (prompt blocks are already in the trie and skip; generated-
+            # region blocks are final now — speculative rewinds and bucket
+            # pad only ever touched positions past/overwritten-below the
+            # final cursor). Then drop our refcounts and free what stayed
+            # private.
+            seq = state.request.prompt + state.generated
+            chain = state.cached_blocks + state.blocks
+            n_full = min(len(seq) // self.pool.block_size, len(chain))
+            now_published = (
+                self.pool.publish(seq, chain[:n_full], refs=0)
+                if n_full else []
+            )
+            in_trie = set(state.published) | set(now_published)
+            self.pool.release(state.cached_blocks + state.published)
+            leftover = [b for b in state.blocks if b not in in_trie]
+            if leftover:
+                self.pool.free(leftover)
+            state.cached_blocks = []
+            state.published = []
+        else:
+            self.pool.free(state.blocks)
         state.blocks = []
         self.slots[slot] = None
         self.finished.append(state)
@@ -320,8 +660,13 @@ class Scheduler:
     def idle(self) -> bool:
         return not self.pending and not self.active
 
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens served from the trie."""
+        total = self.prefix_hit_tokens + self.prefix_miss_tokens
+        return self.prefix_hit_tokens / total if total else 0.0
+
     def stats(self) -> dict:
-        return {
+        out = {
             "pending": len(self.pending),
             "active": len(self.active),
             "finished": len(self.finished),
@@ -331,6 +676,18 @@ class Scheduler:
             "used_blocks": self.pool.used_blocks,
             "block_high_water": self.pool.high_water,
         }
+        if self.pool.prefix_cache:
+            out["prefix_cache"] = {
+                "hit_tokens": self.prefix_hit_tokens,
+                "miss_tokens": self.prefix_miss_tokens,
+                "hit_rate": round(self.prefix_hit_rate(), 6),
+                "decode_route_admits": self.decode_route_admits,
+                "cached_blocks": self.pool.cached_blocks,
+                "evictable_blocks": self.pool.evictable_blocks,
+                "published_total": self.pool.published_total,
+                "evictions": self.pool.evictions,
+            }
+        return out
 
     def gauges(self, now: float | None = None) -> dict:
         """The instantaneous capacity gauges (``metrics.serving_gauges``
@@ -357,6 +714,8 @@ class Scheduler:
             "free_blocks": self.pool.free_blocks,
             "used_blocks": self.pool.used_blocks,
         }
+        if self.pool.prefix_cache:
+            g["prefix_hit_rate"] = round(self.prefix_hit_rate(), 6)
         if now is not None:
             g["oldest_queued_age_s"] = (
                 now - self.pending[0].arrival_s if self.pending else 0.0

@@ -101,6 +101,14 @@ def test_report_on_repo_root(tmp_path):
         ms = rec["artifacts"]["BENCH_MULTISLICE.json"]["headline"]
         assert ms["max_dcn_byte_reduction"] > 2.0
         assert "effective_dcn_bytes_per_sec" in ms  # null-or-number, named
+    # The serving artifact's prefix-cache headline must be carried into
+    # the index (bench_report --check enforces exact-match vs the
+    # artifact; here we pin that the keys exist with sane values).
+    if "BENCH_SERVING.json" in rec["artifacts"]:
+        sv = rec["artifacts"]["BENCH_SERVING.json"]["headline"]
+        assert sv["prefix_prefill_token_reduction_shared"] >= 2.0
+        assert 0.0 <= sv["prefix_adversarial_hit_rate"] <= 0.01
+        assert sv["prefix_tokens_match_cache_off_shared"] is True
 
 
 def test_committed_trajectory_artifact():

@@ -635,3 +635,97 @@ def test_router_rejects_static_batching_by_name():
                         max_seq_len=32, prompt_buckets=(8,), replicas=2)
     with pytest.raises(NotImplementedError, match="static_batching"):
         ReplicaRouter(model, params, cfg, static_batching=True)
+
+
+# ---------------------------------------------------------------------------
+# Prefix-cache fence matrix (serving.prefix_cache x buckets/batching/policy)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kwargs,err,match", [
+    # suffix buckets are meaningless without the cache: fail, don't ignore
+    (dict(suffix_buckets=(4,)), ValueError,
+     "suffix_buckets.*prefix_cache=False"),
+    # malformed suffix bucket ladders fail by name
+    (dict(prefix_cache=True, suffix_buckets=(4, 4)), ValueError,
+     "strictly increasing"),
+    (dict(prefix_cache=True, suffix_buckets=(8, 4)), ValueError,
+     "strictly increasing"),
+    (dict(prefix_cache=True, suffix_buckets=(0,)), ValueError,
+     "strictly increasing"),
+    # widths already compiled as prompt buckets: the compile pin would lie
+    (dict(prefix_cache=True, suffix_buckets=(8,)), ValueError,
+     "duplicate prompt_buckets"),
+    # a suffix width at/above the largest prompt bucket is dead weight
+    (dict(prefix_cache=True, suffix_buckets=(32,)), ValueError,
+     "largest prompt bucket"),
+    # affinity routing reads the trie digest: cache off means no digest
+    (dict(router_policy="prefix_affinity"), ValueError,
+     "prefix_affinity.*prefix_cache=False"),
+    (dict(replicas=2, router_policy="prefix_affinity"), ValueError,
+     "prefix_affinity.*prefix_cache=False"),
+])
+def test_prefix_cache_fence_matrix(kwargs, err, match):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(model=ModelConfig(name="gpt2"),
+                 serving=ServingConfig(prompt_buckets=(8, 16), **kwargs))
+    with pytest.raises(err, match=match):
+        check_serving_composition(cfg)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(prefix_cache=True),
+    dict(prefix_cache=True, suffix_buckets=(4,)),
+    # prefix_affinity at replicas=1 is LEGAL: no router is built and a
+    # single replica trivially owns every prefix — the policy knob ports
+    # unchanged between fleet sizes.
+    dict(replicas=1, prefix_cache=True, router_policy="prefix_affinity"),
+    dict(replicas=3, prefix_cache=True, suffix_buckets=(4,),
+         router_policy="prefix_affinity"),
+    # prefix_cache x speculation composes (warm suffixes feed the same
+    # verify loop); parity is pinned live in tests/test_serving_prefix.py.
+    dict(prefix_cache=True, suffix_buckets=(4,), speculation="ngram:3"),
+    # prefix_cache x sampled requests sharing a prefix is legal — the trie
+    # stores KV, not sampled tokens, and the per-request rng chain is
+    # fold_in(seed, request_id) on every admission path (cold, warm,
+    # decode-route). This row pins the ABSENCE of a fence; the live
+    # parity proof is test_serving_prefix.py::
+    # test_sampled_requests_sharing_a_prefix_are_legal.
+    dict(prefix_cache=True, suffix_buckets=(4,)),
+])
+def test_prefix_cache_legal_compositions_pass(kwargs):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(model=ModelConfig(name="gpt2"),
+                 serving=ServingConfig(prompt_buckets=(8, 16), **kwargs))
+    check_serving_composition(cfg)  # must not raise
+
+
+def test_prefix_cache_rejects_static_batching_by_name():
+    # Static batching admits only into an EMPTY engine, so a warm trie
+    # has nothing to overlap against and the suffix executables would be
+    # compiled for a path that cannot pay off. Engine-ctor fence (the
+    # flag is a constructor argument, invisible to the config check).
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.config import ServingConfig
+    from distributeddeeplearning_tpu.serving import ServingEngine
+
+    model = models.get_model("gpt2", size="tiny", vocab_size=97, max_len=64)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.int32)
+    )["params"]
+    cfg = ServingConfig(slots=2, block_size=4, hbm_budget_mb=8,
+                        max_seq_len=32, prompt_buckets=(8,),
+                        prefix_cache=True)
+    with pytest.raises(NotImplementedError, match="static_batching"):
+        ServingEngine(model, params, cfg, static_batching=True)

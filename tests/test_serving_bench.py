@@ -25,6 +25,14 @@ the sweep (DDL_SERVE_REPLICAS/LOADS/ROUTER_N) and checks per-row shape,
 greedy parity, and the per-fleet compile pin; the scale-out RATIOS
 (4-replica goodput >= 3x single at 10x load, 100x shed rate) are pinned
 on the committed full-sweep artifact only.
+
+PR 15 adds the ``prefix_cache`` block: a shared-prefix trace (M system
+prompts x short suffixes) served cache-on/cache-off plus the
+adversarial random-byte trace replayed cache-on as the honest ~0%-hit
+control. The smoke leg checks shape, token parity on both traces, the
+counter conservation (hit + miss == prompt tokens), and the widened
+compile pin; the headline RATIOS (>= 2x prefill-token reduction,
+improved p50 TTFT) are pinned on the committed full-load artifact.
 """
 
 import json
@@ -125,6 +133,32 @@ def _check_shape(rec, n_requests):
     assert 0.0 < sc["spec_accept_rate_repetitive"] <= 1.0
     assert sc["spec_decode_tps_ratio"] > 0
     _check_router_shape(rec)
+    _check_prefix_shape(rec)
+
+
+def _check_prefix_shape(rec):
+    px = rec["prefix_cache"]
+    assert px["serving"]["prefix_cache"] is True
+    on, off, adv = px["rows"]
+    assert on["prefix_cache"] and adv["prefix_cache"]
+    assert not off["prefix_cache"] and off["prefix"] is None
+    comp = px["comparison"]
+    for row in (on, adv):
+        p = row["prefix"]
+        # Counter conservation: every admitted prompt token is either a
+        # trie hit or a miss — nothing double-counted or dropped.
+        assert p["hit_tokens"] + p["miss_tokens"] == row["prompt_tokens"]
+        assert 0.0 <= p["hit_rate"] <= 1.0
+        # The widened AOT pin: prompt widths + suffix widths + decode,
+        # all at warmup, nothing after — warm traffic included.
+        assert (row["compiles_after_run"] == row["compiles_warmup"]
+                == comp["compile_pin"])
+    # KV reuse changes where cache reads come from, never the tokens.
+    assert comp["tokens_match_cache_off_shared"] is True
+    assert comp["tokens_match_reference_adversarial"] is True
+    # Unique random prompts cannot hit: the control reports ~0 honestly.
+    assert comp["adversarial_hit_rate"] <= 0.01
+    assert comp["zero_recompiles_with_cache"] is True
 
 
 def _check_router_shape(rec):
@@ -220,3 +254,11 @@ def test_bench_serving_artifact():
     assert rcomp["tokens_match_reference"] is True
     assert rcomp["zero_recompiles_per_replica"] is True
     assert rcomp["p99_ttft_bounded_under_shedding"] is True
+    # Prefix-cache headline (the full-load shared-prefix trace): the
+    # trie must remove at least half the prefill tokens and the warm
+    # engine's median first token must arrive sooner, at a hit rate that
+    # is neither degenerate-0 nor a fabricated 100%.
+    pxc = rec["prefix_cache"]["comparison"]
+    assert pxc["prefill_token_reduction_shared"] >= 2.0
+    assert pxc["p50_ttft_improved_shared"] is True
+    assert 0.0 < pxc["shared_hit_rate"] < 1.0

@@ -309,3 +309,115 @@ def test_router_replica_telemetry_merges_into_fleet(tmp_path):
     hists = fleet["histograms"]
     assert hists["prefill"]["count"] == 4  # one prefill per request
     assert hists["ttft"]["count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# prefix_affinity: dispatch follows the warm trie, bounded by the guard
+# ---------------------------------------------------------------------------
+
+_AFF_CFG = ServingConfig(**{
+    **vars(_CFG), "router_policy": "prefix_affinity",
+    "prefix_cache": True, "suffix_buckets": (4,),
+})
+
+
+def _shared(n, seed=3):
+    rng = np.random.default_rng(seed)
+    prefix = list(map(int, rng.integers(1, 97, 8)))
+    return [prefix + list(map(int, rng.integers(1, 97, 2 + i % 5)))
+            for i in range(n)]
+
+
+def test_prefix_affinity_routes_warm_prompts_home():
+    # One cold request seeds a replica's trie; later arrivals sharing its
+    # prefix must follow it there (cached-prefix savings beat an idle
+    # replica), while unrelated prompts still spread least-loaded. Tokens
+    # stay equal to the plain single-engine oracle — affinity changes
+    # WHERE a request runs, never its numbers.
+    model, params = _model_and_params()
+    warm = _shared(4)
+    cold = _prompts((7,), seed=99)
+    ref = _reference(model, params, warm + cold)
+    router = ReplicaRouter(model, params, _AFF_CFG)
+    router.submit(Request(prompt=list(warm[0]), max_new_tokens=9,
+                          request_id=0))
+    router.run()
+    home = router.routes[0]
+    assert router.replicas[home].engine.prefix_match_len(warm[1]) == 8
+    # Warm arrivals chase the trie; the cold one balances on load (the
+    # home replica's queue is deeper, so least-loaded picks the other).
+    for j, p in enumerate(warm[1:], start=1):
+        router.submit(Request(prompt=list(p), max_new_tokens=9,
+                              request_id=j))
+    router.submit(Request(prompt=list(cold[0]), max_new_tokens=9,
+                          request_id=len(warm)))
+    assert all(router.routes[j] == home for j in range(1, len(warm)))
+    assert router.routes[len(warm)] == 1 - home
+    done = router.run()
+    assert len(done) == len(warm) + 1
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    hit = router.replicas[home].engine.stats()["prefix_cache"]
+    assert hit["hit_tokens"] > 0
+
+
+def test_prefix_affinity_starvation_guard_spreads_bursts():
+    # A same-prefix burst deeper than one lane-batch must spill: affinity
+    # concentrates warm traffic only while the home queue is within
+    # `slots` of the idlest replica, then falls back to least-loaded —
+    # a hot prefix never starves the rest of the fleet.
+    model, params = _model_and_params()
+    burst = _shared(8, seed=5)
+    ref = _reference(model, params, burst)
+    router = ReplicaRouter(model, params, _AFF_CFG)
+    router.submit(Request(prompt=list(burst[0]), max_new_tokens=9,
+                          request_id=0))
+    router.run()
+    home = router.routes[0]
+    for j, p in enumerate(burst[1:], start=1):
+        router.submit(Request(prompt=list(p), max_new_tokens=9,
+                              request_id=j))
+    lanes = [router.routes[j] for j in range(1, len(burst))]
+    assert home in lanes
+    assert (1 - home) in lanes, "guard never spilled the burst"
+    # The spill point honors the bound: first slots+1 stay home.
+    assert lanes[:_AFF_CFG.slots + 1] == [home] * (_AFF_CFG.slots + 1)
+    done = router.run()
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+
+
+def test_prefix_affinity_quarantine_reroutes_to_cold_survivor():
+    # The warm replica dies: its queued share re-routes to the survivor,
+    # whose trie has never seen the prefix — requests run cold there and
+    # must still match the oracle (the trie is replica state and dies
+    # with its engine; the router holds no prefix map to invalidate).
+    model, params = _model_and_params()
+    cfg = ServingConfig(**{
+        **vars(_AFF_CFG), "slots": 1,
+    })
+    prompts = _shared(3, seed=11)
+    ref = _reference(model, params, prompts)
+    router = ReplicaRouter(model, params, cfg)
+    router.submit(Request(prompt=list(prompts[0]), max_new_tokens=9,
+                          request_id=0))
+    router.run()
+    home = router.routes[0]
+
+    def boom():
+        raise RuntimeError("injected step fault")
+
+    for j, p in enumerate(prompts[1:], start=1):
+        router.submit(Request(prompt=list(p), max_new_tokens=9,
+                              request_id=j))
+    assert all(v == home for k, v in router.routes.items() if k > 0)
+    router.replicas[home].engine.step = boom
+    done = router.run()
+    # Cumulative fleet-wide: the seed request (completed before the
+    # fault) plus both queued requests, finished on the survivor.
+    assert len(done) == 3
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    assert router.stats()["rerouted"] == 2
+    assert all(v == 1 - home
+               for k, v in router.routes.items() if k > 0)

@@ -1,8 +1,10 @@
 """Host-side serving units: KV block pool + continuous-batching scheduler.
 
-Pure Python (serving/scheduler.py imports no jax) — admission policy and
-block accounting are exercised here without a device; the device half is
-tests/test_serving.py.
+Pure Python (serving/scheduler.py imports no jax) — admission policy,
+block accounting, and the prefix trie (content addressing, refcounts,
+LRU eviction, suffix-aware reservations) are exercised here without a
+device; the device half is tests/test_serving.py and
+tests/test_serving_prefix.py.
 """
 
 import pytest
@@ -22,13 +24,33 @@ def _bucket_of(plen):
     raise ValueError(plen)
 
 
-def _sched(slots=2, num_blocks=64, block_size=4, max_seq_len=32):
-    return Scheduler(slots, KVBlockPool(num_blocks, block_size), max_seq_len)
+def _suffix_of(slen):
+    # The engine's suffix_bucket_of: smallest width from suffix buckets
+    # (4,) union prompt buckets (8, 16, 32).
+    for b in (4, 8, 16, 32):
+        if slen <= b:
+            return b
+    raise ValueError(slen)
+
+
+def _sched(slots=2, num_blocks=64, block_size=4, max_seq_len=32,
+           prefix_cache=False):
+    return Scheduler(
+        slots,
+        KVBlockPool(num_blocks, block_size, prefix_cache=prefix_cache),
+        max_seq_len,
+    )
 
 
 def _req(plen=4, max_new=4, **kw):
     return Request(prompt=list(range(1, plen + 1)), max_new_tokens=max_new,
                    **kw)
+
+
+def _padmit(s, now):
+    """Admit with the prefix-cache plumbing the engine would pass."""
+    return s.admit(now, _bucket_of, suffix_bucket_of=_suffix_of,
+                   cover_tokens=32)
 
 
 # ---------------------------------------------------------------------------
@@ -308,3 +330,304 @@ def test_gauges_deadline_headroom_is_min_over_queued():
     assert s.gauges(1.0)["queued_deadline_headroom_s"] == pytest.approx(3.0)
     # Negative headroom = already doomed (dropped at the next admit pass).
     assert s.gauges(6.0)["queued_deadline_headroom_s"] == pytest.approx(-2.0)
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache: content-addressed trie over the block pool
+# ---------------------------------------------------------------------------
+
+
+def _seed_chain(pool, tokens, *, refs=0):
+    """Publish ``tokens``'s full blocks into the trie (the shortest path a
+    completed request takes) and return the chain's block ids."""
+    n = len(tokens) // pool.block_size
+    blocks = pool.alloc(n)
+    assert blocks is not None
+    pool.publish(tokens[:n * pool.block_size], blocks, refs=refs)
+    return blocks
+
+
+def test_prefix_cache_off_pool_is_inert():
+    pool = KVBlockPool(16, 4)
+    blocks = pool.alloc(2)
+    assert pool.match([1, 2, 3, 4, 5]) == []
+    assert pool.publish([1, 2, 3, 4, 5, 6, 7, 8], blocks, refs=0) == []
+    assert pool.cached_blocks == 0
+    pool.free(blocks)  # still request-owned: publish was a no-op
+
+
+def test_match_is_longest_chain_capped_before_last_token():
+    pool = KVBlockPool(16, 4, prefix_cache=True)
+    toks = list(range(1, 13))  # 12 tokens = 3 full blocks
+    blocks = _seed_chain(pool, toks)
+    # Identical prompt: cap at (12 - 1) // 4 = 2 — the last token must be
+    # computed, so the final block is never served from cache.
+    assert pool.match(toks) == blocks[:2]
+    assert pool.match_len(toks) == 8
+    # One token longer: all 3 cached blocks now fit under the cap.
+    assert pool.match(toks + [99]) == blocks
+    # First chunk differs: chain hash misses at the root.
+    assert pool.match([55] + toks[1:]) == []
+    # Divergence after the first block: only the shared block hits.
+    assert pool.match(toks[:4] + [77] * 8) == blocks[:1]
+    # Read-only probe: no refcount or occupancy effect.
+    assert pool.evictable_blocks == 3
+
+
+def test_publish_duplicate_content_keeps_existing_copy():
+    pool = KVBlockPool(16, 4, prefix_cache=True)
+    toks = list(range(1, 9))
+    first = _seed_chain(pool, toks, refs=0)
+    dup = pool.alloc(2)
+    # Same content in different physical blocks: the trie keeps the
+    # existing copy, ours stays request-owned and frees normally.
+    assert pool.publish(toks, dup, refs=1) == []
+    assert pool.match(toks + [0]) == first
+    assert pool.cached_blocks == 2
+    pool.free(dup)
+
+
+def test_release_and_free_guard_cached_blocks():
+    pool = KVBlockPool(16, 4, prefix_cache=True)
+    (b,) = _seed_chain(pool, [1, 2, 3, 4], refs=1)
+    pool.release([b])
+    with pytest.raises(ValueError):  # refcount underflow
+        pool.release([b])
+    with pytest.raises(ValueError):  # never acquired / not in trie
+        pool.release([15])
+    with pytest.raises(ValueError):  # cached blocks are not request-owned
+        pool.free([b])
+
+
+def test_refcounted_blocks_never_evicted_under_pressure():
+    pool = KVBlockPool(4, 4, prefix_cache=True)  # 3 usable blocks
+    hot = _seed_chain(pool, [1, 2, 3, 4], refs=1)   # a live request maps it
+    _seed_chain(pool, [9, 8, 7, 6], refs=0)         # warm but unmapped
+    assert pool.free_blocks == 1 and pool.evictable_blocks == 1
+    got = pool.alloc(2)  # must reclaim the refcount-0 node, not the hot one
+    assert got is not None
+    assert pool.match([1, 2, 3, 4, 0]) == hot
+    assert pool.match([9, 8, 7, 6, 0]) == []
+    assert pool.evictions == 1
+    # Only the pinned node remains: nothing further is reclaimable.
+    assert not pool.can_alloc(2)
+    assert pool.alloc(2) is None
+
+
+def test_evict_subtree_interior_node_detaches_children():
+    pool = KVBlockPool(16, 4, prefix_cache=True)
+    toks = list(range(1, 13))
+    blocks = _seed_chain(pool, toks)  # chain of 3, all refcount 0
+    freed = pool.evict_subtree(blocks[1])  # interior node
+    assert set(freed) == set(blocks[1:])   # children went with it
+    assert pool.match(toks + [0]) == blocks[:1]
+    assert pool.cached_blocks == 1
+    assert pool.free_blocks == 15 - 1
+
+
+def test_evict_subtree_refuses_live_nodes():
+    pool = KVBlockPool(16, 4, prefix_cache=True)
+    blocks = _seed_chain(pool, list(range(1, 9)), refs=0)
+    pool.acquire([blocks[1]])  # a live mapping deep in the subtree
+    with pytest.raises(ValueError):
+        pool.evict_subtree(blocks[0])
+    pool.release([blocks[1]])
+    assert sorted(pool.evict_subtree(blocks[0])) == sorted(blocks)
+    with pytest.raises(ValueError):
+        pool.evict_subtree(blocks[0])  # no longer cached
+
+
+def test_lru_eviction_order_is_deterministic():
+    pool = KVBlockPool(6, 4, prefix_cache=True)  # 5 usable
+    a = _seed_chain(pool, [1, 1, 1, 1])  # tick 1
+    b = _seed_chain(pool, [2, 2, 2, 2])  # tick 2
+    c = _seed_chain(pool, [3, 3, 3, 3])  # tick 3
+    pool.acquire(a)  # logical-clock touch: order is now b < c < a
+    pool.release(a)
+    pool.alloc(3)    # free 2 + one eviction -> b (LRU) goes first
+    assert pool.match([2, 2, 2, 2, 0]) == []
+    assert pool.match([3, 3, 3, 3, 0]) == c
+    assert pool.match([1, 1, 1, 1, 0]) == a
+
+
+def test_lru_tie_breaks_on_block_id():
+    pool = KVBlockPool(6, 4, prefix_cache=True)
+    a = _seed_chain(pool, [1, 1, 1, 1])
+    b = _seed_chain(pool, [2, 2, 2, 2])
+    pool.acquire(a + b)  # one shared tick: a and b tie on last_use
+    pool.release(a + b)
+    pool.alloc(4)        # needs one eviction; a holds the lower block id
+    assert a[0] < b[0]
+    assert pool.match([1, 1, 1, 1, 0]) == []
+    assert pool.match([2, 2, 2, 2, 0]) == b
+
+
+def test_flush_cache_returns_every_block():
+    pool = KVBlockPool(16, 4, prefix_cache=True)
+    _seed_chain(pool, list(range(1, 13)))
+    _seed_chain(pool, list(range(50, 62)))
+    assert pool.cached_blocks == 6
+    assert pool.flush_cache() == 6
+    assert pool.cached_blocks == 0 and pool.free_blocks == 15
+
+
+# ---------------------------------------------------------------------------
+# Scheduler admission with the prefix cache: suffix-only reservations
+# ---------------------------------------------------------------------------
+
+
+def test_admission_reservation_at_each_hit_rate():
+    s = _sched(slots=3, num_blocks=64, prefix_cache=True)
+    prompt = list(range(1, 9))  # plen 8
+
+    # 0% hit (cold): reserve blocks_for(max(bucket=8, 8+4)) = 3.
+    s.submit(Request(prompt=list(prompt), max_new_tokens=4), now=0.0)
+    (cold,) = _padmit(s, 0.0)
+    assert cold.cached_blocks == [] and cold.cached_len == 0
+    assert cold.bucket == 8 and len(cold.blocks) == 3
+    s.complete(cold.slot, now=1.0)  # publishes both full prompt blocks
+    assert s.pool.cached_blocks == 2
+
+    # 50% hit: identical prompt; the match caps at 1 block (strict
+    # prefix), so the suffix is 4 tokens -> suffix bucket 4, and the
+    # reservation drops by exactly the cached block: 3 - 1 = 2.
+    s.submit(Request(prompt=list(prompt), max_new_tokens=4), now=2.0)
+    (warm,) = _padmit(s, 2.0)
+    assert warm.cached_len == 4 and len(warm.cached_blocks) == 1
+    assert warm.bucket == 4 and not warm.decode_route
+    assert len(warm.blocks) == 2
+
+    # 100% full-block hit: a 9-token prompt extending the cached chain
+    # leaves a one-token suffix -> decode route, no prefill bucket, and
+    # only the uncached tail is reserved: blocks_for(9 + 4) - 2 = 2.
+    s.submit(Request(prompt=list(range(1, 10)), max_new_tokens=4), now=3.0)
+    (full,) = _padmit(s, 3.0)
+    assert full.decode_route and full.bucket == 0
+    assert full.cached_len == 8 and len(full.cached_blocks) == 2
+    assert len(full.blocks) == 2
+
+    assert s.prefix_hit_tokens == 4 + 8
+    assert s.prefix_miss_tokens == 8 + 4 + 1
+    assert s.decode_route_admits == 1
+    assert s.prefix_hit_rate() == pytest.approx(12 / 25)
+
+
+def test_admission_trims_hit_to_fit_row_cover():
+    # A suffix-bucket overshoot past the page-table row would write pad KV
+    # through a clamped table index, so admit trims the hit until
+    # cached_len + suffix_bucket fits cover_tokens.
+    s = _sched(slots=2, num_blocks=64, prefix_cache=True)
+    prompt = list(range(1, 11))  # plen 10
+    s.submit(Request(prompt=list(prompt), max_new_tokens=2), now=0.0)
+    (a,) = _padmit(s, 0.0)
+    s.complete(a.slot, now=0.0)  # caches 2 full blocks (tokens 1..8)
+    assert s.pool.cached_blocks == 2
+
+    # Partial trim: with only an 8-wide suffix bucket, a 2-block hit
+    # covers 8 + 8 = 16 > 12, but 1 block covers 4 + 8 = 12 — keep one.
+    s.submit(Request(prompt=list(prompt), max_new_tokens=2), now=1.0)
+    (b,) = s.admit(1.0, _bucket_of, suffix_bucket_of=lambda L: 8,
+                   cover_tokens=12)
+    assert b.cached_len == 4 and len(b.cached_blocks) == 1
+    assert b.bucket == 8 and len(b.blocks) == 2  # blocks_for(12) - 1
+    assert s.pool.evictable_blocks == 1  # the trimmed block was not acquired
+    s.complete(b.slot, now=2.0)
+
+    # Full trim: no warm configuration fits an 11-token row — the request
+    # degrades to the cold path with every refcount returned.
+    s.submit(Request(prompt=list(prompt), max_new_tokens=2), now=3.0)
+    (c,) = s.admit(3.0, _bucket_of, suffix_bucket_of=_suffix_of,
+                   cover_tokens=11)
+    assert c.cached_blocks == [] and c.cached_len == 0
+    assert c.bucket == 16 and not c.decode_route
+    assert s.pool.evictable_blocks == s.pool.cached_blocks
+
+
+def test_admission_acquires_before_alloc_evicts():
+    # The matched chain must survive the eviction that its own admission
+    # triggers: acquire runs before alloc, pinning the hit at refcount 1.
+    s = _sched(slots=2, num_blocks=8, block_size=4, max_seq_len=16,
+               prefix_cache=True)  # 7 usable blocks
+    s.submit(Request(prompt=list(range(1, 9)), max_new_tokens=4), now=0.0)
+    (a,) = _padmit(s, 0.0)
+    s.complete(a.slot, now=0.0)          # 2 nodes cached, refcount 0
+    _seed_chain(s.pool, [90, 91, 92, 93])  # decoy chain, refcount 0
+    assert s.pool.free_blocks == 4 and s.pool.cached_blocks == 3
+    # Warm re-admission: 1-block hit + blocks_for(max(8, 16)) - 1 = 3
+    # fresh blocks, all from the free list — no eviction yet.
+    s.submit(Request(prompt=list(range(1, 9)), max_new_tokens=8), now=1.0)
+    (b,) = _padmit(s, 1.0)
+    assert b.cached_len == 4
+    assert s.pool.evictions == 0
+    # Now force pressure: a cold request needing blocks_for(12) = 3 with
+    # only 1 block free — two refcount-0 nodes must be reclaimed.
+    s.submit(Request(prompt=list(range(40, 48)), max_new_tokens=4), now=2.0)
+    (c,) = _padmit(s, 2.0)
+    # Eviction reclaimed refcount-0 nodes only; b's pinned hit survived.
+    assert s.pool.evictions >= 1
+    assert s.pool.match_len(list(range(1, 9))) == 4
+    assert b.cached_blocks[0] in s.pool._cached
+    assert s.pool._cached[b.cached_blocks[0]].refs == 1
+
+
+def test_prefix_stats_and_gauges_shape():
+    s = _sched(prefix_cache=True)
+    assert "prefix_hit_rate" in s.gauges()
+    assert set(s.stats()["prefix_cache"]) == {
+        "hit_tokens", "miss_tokens", "hit_rate", "decode_route_admits",
+        "cached_blocks", "evictable_blocks", "published_total", "evictions",
+    }
+    plain = _sched()
+    assert "prefix_hit_rate" not in plain.gauges()
+    assert "prefix_cache" not in plain.stats()
+
+
+def test_no_block_leaks_with_prefix_cache_1k():
+    # The 1k leak check, rerun over ref-counted shared-prefix traffic:
+    # conservation now reads used + free + cached == usable at every step,
+    # refcounts must equal the live mappings exactly, and after the last
+    # completion plus a full flush the free list holds the whole pool.
+    import random
+
+    rnd = random.Random(7)
+    prefixes = [[p * 100 + i for i in range(8)] for p in range(1, 5)]
+    s = _sched(slots=4, num_blocks=32, block_size=4, max_seq_len=32,
+               prefix_cache=True)
+    submitted = finished = 0
+    now = 0.0
+    while finished < 1000:
+        now += 1.0
+        if submitted < 1000 and len(s.pending) < 8:
+            prompt = (list(rnd.choice(prefixes))
+                      + [rnd.randint(1, 50) for _ in range(rnd.randint(1, 6))])
+            s.submit(Request(prompt=prompt,
+                             max_new_tokens=rnd.randint(1, 8)), now=now)
+            submitted += 1
+        for st in _padmit(s, now):
+            # Simulate the engine's post-prefill publish.
+            s.publish_prefix(st, len(st.request.prompt))
+        for st in list(s.active):
+            if rnd.random() < 0.5:
+                st.generated = [rnd.randint(1, 50)
+                                for _ in range(st.request.max_new_tokens)]
+                s.complete(st.slot, now=now)
+                finished += 1
+        # Conservation: every usable block is free, request-owned, or
+        # cached — no orphans, no double-homing.
+        assert (s.pool.used_blocks + s.pool.free_blocks
+                + s.pool.cached_blocks == 31)
+        assert s.pool.used_blocks == sum(
+            len(st.blocks) - len(st.published) for st in s.active
+        )
+        # Refcounts == live mappings (cached hits + own published blocks).
+        assert sum(nd.refs for nd in s.pool._cached.values()) == sum(
+            len(st.cached_blocks) + len(st.published) for st in s.active
+        )
+    assert s.pool.used_blocks == 0
+    assert s.pool.evictable_blocks == s.pool.cached_blocks
+    s.pool.flush_cache()
+    assert s.pool.cached_blocks == 0
+    assert s.pool.free_blocks == 31
+    assert len(s.finished) == 1000
+    for st in s.finished:
+        assert st.blocks == [] and st.published == []

@@ -26,11 +26,12 @@ $DDL_REPORT_OUT).
 
 ``python tools/bench_report.py --check`` validates the COMMITTED
 artifacts this index points at without re-measuring: today that means
-BENCH_SERVING.json's router block (the scale-out + shedding claims) and,
-when BENCH_TRAJECTORY.json exists, that its serving entry actually
-carries the router headline — an index that silently drops the headline
-it was grown to surface is a regression. Exits non-zero listing every
-failure.
+BENCH_SERVING.json's router block (the scale-out + shedding claims) and
+prefix_cache block (the shared-prefix KV-reuse reduction, parity, and
+adversarial control), and, when BENCH_TRAJECTORY.json exists, that its
+serving entry actually carries the router and prefix headlines — an
+index that silently drops a headline it was grown to surface is a
+regression. Exits non-zero listing every failure.
 """
 
 from __future__ import annotations
@@ -103,6 +104,16 @@ def _headline(rec: dict) -> dict:
                   "tokens_match_reference"):
             if k in rtr["comparison"]:
                 out["router_" + k] = rtr["comparison"][k]
+    # Serving prefix-cache block: the KV-reuse headline — prefill tokens
+    # removed by the trie on the shared-prefix trace, the warm TTFT win,
+    # and the honest ~0 hit rate on the adversarial control.
+    px = rec.get("prefix_cache")
+    if isinstance(px, dict) and isinstance(px.get("comparison"), dict):
+        for k in ("prefill_token_reduction_shared", "shared_hit_rate",
+                  "p50_ttft_ratio_shared", "adversarial_hit_rate",
+                  "tokens_match_cache_off_shared"):
+            if k in px["comparison"]:
+                out["prefix_" + k] = px["comparison"][k]
     # FLEET.json (tools/telemetry_report.py fleet rehearsal): the pod-level
     # headline the aggregator exists for.
     fh = rec.get("headline")
@@ -198,6 +209,21 @@ def check() -> int:
           rcomp.get("zero_recompiles_per_replica") is True)
     claim("p99_ttft_bounded_under_shedding",
           rcomp.get("p99_ttft_bounded_under_shedding") is True)
+    # The prefix-cache block (shared-prefix KV reuse): the headline
+    # reduction, parity, and the honest adversarial control.
+    pcomp = serving.get("prefix_cache", {}).get("comparison", {})
+    claim("prefix_cache block present", bool(pcomp))
+    claim("prefill_token_reduction_shared >= 2.0",
+          (pcomp.get("prefill_token_reduction_shared") or 0) >= 2.0)
+    claim("p50_ttft_improved_shared",
+          pcomp.get("p50_ttft_improved_shared") is True)
+    claim("tokens_match_cache_off_shared",
+          pcomp.get("tokens_match_cache_off_shared") is True)
+    adv_hit = pcomp.get("adversarial_hit_rate")
+    claim("adversarial_hit_rate <= 0.01",
+          adv_hit is not None and 0.0 <= adv_hit <= 0.01)
+    claim("prefix zero_recompiles_with_cache",
+          pcomp.get("zero_recompiles_with_cache") is True)
 
     # The index, when committed, must surface the router headline for the
     # serving artifact (the whole point of indexing it).
@@ -212,6 +238,12 @@ def check() -> int:
         claim("trajectory carries router_shed_rate_100x_1_replica",
               head.get("router_shed_rate_100x_1_replica")
               == rcomp.get("shed_rate_100x_1_replica"))
+        claim("trajectory carries prefix_prefill_token_reduction_shared",
+              head.get("prefix_prefill_token_reduction_shared")
+              == pcomp.get("prefill_token_reduction_shared"))
+        claim("trajectory carries prefix_adversarial_hit_rate",
+              head.get("prefix_adversarial_hit_rate")
+              == pcomp.get("adversarial_hit_rate"))
 
     if failures:
         print(f"bench_report --check: {len(failures)} claim(s) FAILED:")
