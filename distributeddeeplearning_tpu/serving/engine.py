@@ -69,7 +69,10 @@ prompt token cached) skips prefill entirely: the lane is armed with the
 last prompt token as pending input and the first token comes from the
 next batched decode/verify step. Prompt blocks are published into the
 trie right after prefill (their KV is final then); generation-extended
-full blocks are published at completion.
+full blocks are published at completion — EXCEPT the block holding the
+final sampled token, whose KV was never written (the token is sampled
+but never fed back), so a block-aligned finish withholds its last block
+rather than serve garbage KV to a continuation prompt.
 """
 
 from __future__ import annotations

@@ -19,9 +19,11 @@ Design (docs/SERVING.md):
   so a hash names the whole token prefix up to and including the block,
   and equal prefixes dedupe regardless of which request wrote them).
   Cached blocks carry a refcount (live requests mapping the block into
-  their page table) and a logical LRU tick; ``alloc`` evicts refcount-0
-  nodes leaf-first under pressure, so capacity = free list + evictable
-  cache. A block is in exactly one of three states: free, request-owned
+  their page table, or holding published descendants below it —
+  ``publish`` pins the existing chain it continues through so a parent
+  never drops to refcount 0 above a refcount>0 child) and a logical LRU
+  tick; ``alloc`` evicts refcount-0 nodes leaf-first under pressure, so
+  capacity = free list + evictable cache. A block is in exactly one of three states: free, request-owned
   (``_allocated``), or cached (``_cached``) — conservation over the three
   is a tested invariant.
 - **Scheduler** — FIFO admission into ``slots`` decode lanes. A queued
@@ -265,7 +267,7 @@ class KVBlockPool:
             nd.refs -= 1
 
     def publish(self, tokens: list[int], blocks: list[int], *,
-                refs: int) -> list[int]:
+                refs: int) -> tuple[list[int], list[int]]:
         """Publish full blocks into the trie: ``blocks[k]`` holds the KV of
         ``tokens[k*bs:(k+1)*bs]``. Walks the chain from the root; blocks
         already in the trie are skipped, a block whose content hash is
@@ -273,13 +275,23 @@ class KVBlockPool:
         existing copy wins; ours is freed normally at completion), and
         newly published blocks move from ``_allocated`` to the cache at
         refcount ``refs`` (1 when the publishing request keeps using them,
-        0 at completion). Returns the newly published block ids."""
+        0 at completion). Returns ``(published, traversed)``: the newly
+        published block ids and the already-cached ids the chain continued
+        through. With ``refs > 0`` each traversed node's refcount is
+        incremented — the publisher's new nodes hang below the traversed
+        chain, and without the refcount the chain's original owner could
+        release it to 0 while our refcount>0 children live, breaking the
+        closed-under-descendants invariant leaf-first eviction relies on
+        (``evictable_blocks`` would count pinned interior nodes that
+        ``_evict_one`` can never reclaim). The caller must release
+        ``traversed`` at completion, exactly like ``published``."""
         if not self.prefix_cache:
-            return []
+            return [], []
         if len(blocks) * self.block_size > len(tokens):
             raise ValueError("publish: blocks cover more tokens than given")
         self._tick += 1
         published: list[int] = []
+        traversed: list[int] = []
         parent_hash = _ROOT_HASH
         parent_block: int | None = None
         for k, b in enumerate(blocks):
@@ -290,7 +302,11 @@ class KVBlockPool:
                 # Already cached (possibly by us, possibly a duplicate in
                 # another block) — the chain continues through the cached
                 # copy either way.
-                self._cached[existing].last_use = self._tick
+                nd = self._cached[existing]
+                nd.last_use = self._tick
+                if refs > 0:
+                    nd.refs += 1
+                    traversed.append(existing)
                 parent_block = existing
                 continue
             if b not in self._allocated:
@@ -305,7 +321,7 @@ class KVBlockPool:
             published.append(b)
             self.published_total += 1
             parent_block = b
-        return published
+        return published, traversed
 
     def _drop_node(self, b: int) -> None:
         """Remove one childless cache node and return its block to the
@@ -400,12 +416,16 @@ class RequestState:
     # Prefix-cache bookkeeping (all empty/0 with the cache off): trie
     # blocks mapped at admission (refcount held, released at completion),
     # the token count they cover, blocks WE own that were published into
-    # the trie mid-flight (released, not freed, at completion), and
-    # whether the hit covered all but the last prompt token (no prefill —
-    # the first token comes from the plain decode step).
+    # the trie mid-flight (released, not freed, at completion), cached
+    # blocks our mid-flight publish chained THROUGH (one extra refcount
+    # each, released at completion — they pin the chain our published
+    # nodes hang below), and whether the hit covered all but the last
+    # prompt token (no prefill — the first token comes from the plain
+    # decode step).
     cached_blocks: list[int] = dataclasses.field(default_factory=list)
     cached_len: int = 0
     published: list[int] = dataclasses.field(default_factory=list)
+    trie_refs: list[int] = dataclasses.field(default_factory=list)
     decode_route: bool = False
     slot: int = -1
     generated: list[int] = dataclasses.field(default_factory=list)
@@ -600,8 +620,10 @@ class Scheduler:
         into the trie at refcount 1 (the request keeps decoding over them)
         — the engine calls this right after prefill, when their KV is
         written and final, so later arrivals in the same wave already hit.
-        Newly published blocks move to ``state.published`` (released, not
-        freed, at completion). Returns the number published."""
+        Newly published blocks move to ``state.published``, and cached
+        nodes the chain continued through (another same-wave request beat
+        us to a shared block) move to ``state.trie_refs`` — both released,
+        not freed, at completion. Returns the number published."""
         if not self.pool.prefix_cache:
             return 0
         bs = self.pool.block_size
@@ -609,10 +631,11 @@ class Scheduler:
         n_full = min(n_tokens // bs, len(chain))
         if n_full <= 0:
             return 0
-        got = self.pool.publish(
+        got, traversed = self.pool.publish(
             state.request.prompt[:n_full * bs], chain[:n_full], refs=1
         )
         state.published.extend(got)
+        state.trie_refs.extend(traversed)
         return len(got)
 
     # -- retirement --------------------------------------------------------
@@ -623,26 +646,36 @@ class Scheduler:
             raise ValueError(f"slot {slot} is empty")
         state.finish_s = now
         if self.pool.prefix_cache:
-            # Publish the finished sequence's full blocks at refcount 0
-            # (prompt blocks are already in the trie and skip; generated-
-            # region blocks are final now — speculative rewinds and bucket
-            # pad only ever touched positions past/overwritten-below the
-            # final cursor). Then drop our refcounts and free what stayed
-            # private.
+            # Publish the finished sequence's full WRITTEN blocks at
+            # refcount 0 (prompt blocks are already in the trie and skip;
+            # generated-region blocks are final now — speculative rewinds
+            # and bucket pad only ever touched positions past/overwritten-
+            # below the final cursor). The completing token itself was
+            # sampled but never fed back through the model, so its KV slot
+            # is UNWRITTEN — publishing its block would let a later prompt
+            # extending this sequence attend to garbage KV. With no
+            # generated tokens (direct scheduler-level completion) prefill
+            # wrote every prompt position. Then drop our refcounts and
+            # free what stayed private.
             seq = state.request.prompt + state.generated
             chain = state.cached_blocks + state.blocks
-            n_full = min(len(seq) // self.pool.block_size, len(chain))
+            written = len(seq) - (1 if state.generated else 0)
+            n_full = min(written // self.pool.block_size, len(chain))
             now_published = (
-                self.pool.publish(seq, chain[:n_full], refs=0)
+                self.pool.publish(seq[:n_full * self.pool.block_size],
+                                  chain[:n_full], refs=0)[0]
                 if n_full else []
             )
             in_trie = set(state.published) | set(now_published)
-            self.pool.release(state.cached_blocks + state.published)
+            self.pool.release(
+                state.cached_blocks + state.published + state.trie_refs
+            )
             leftover = [b for b in state.blocks if b not in in_trie]
             if leftover:
                 self.pool.free(leftover)
             state.cached_blocks = []
             state.published = []
+            state.trie_refs = []
         else:
             self.pool.free(state.blocks)
         state.blocks = []

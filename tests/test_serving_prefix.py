@@ -133,6 +133,33 @@ def test_warm_greedy_matches_frozen_golden(name):
     assert pc["decode_route_admits"] >= 1  # the repeated 9-token prompt
 
 
+@pytest.mark.parametrize("name", ["gpt2", "llama"])
+def test_multiturn_continuation_after_block_aligned_finish(name):
+    # The motivating multi-turn workload crossed with a block-aligned
+    # finish: turn 1 ends with len(prompt) + len(generated) an exact
+    # multiple of block_size, and turn 2 replays the whole turn-1
+    # sequence plus a follow-up. The final turn-1 token's KV was never
+    # written (sampled, never fed back through the model), so its block
+    # must be withheld from the trie at completion — a warm engine that
+    # matched it would attend to garbage KV and diverge from cold.
+    model, params = _model_and_params(name)
+    prompts = _prompts((6, 6), seed=11)
+    turns = []
+    for cfg in (_CFG, _CFG_OFF):
+        eng = _engine(model, params, cfg)
+        (w1,) = _run_waves(eng, [prompts], max_new=6)  # 6+6: aligned
+        assert all(len(g) == 6 for g in w1)
+        follow = [list(p) + list(g) + [7, 3] for p, g in zip(prompts, w1)]
+        (w2,) = _run_waves(eng, [follow], max_new=6)
+        turns.append((w1, w2))
+        if cfg.prefix_cache:
+            # Per follow-up: 2 of the 3 matchable blocks are served warm
+            # (8 tokens); the block holding the unwritten final-token KV
+            # must not count as a hit.
+            assert eng.stats()["prefix_cache"]["hit_tokens"] == 16
+    assert turns[0] == turns[1]
+
+
 def test_decode_route_skips_prefill_entirely():
     # A prompt extending a fully cached chain by one token takes the
     # decode route: no prefill call, first token from the next batched

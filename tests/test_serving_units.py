@@ -351,7 +351,7 @@ def test_prefix_cache_off_pool_is_inert():
     pool = KVBlockPool(16, 4)
     blocks = pool.alloc(2)
     assert pool.match([1, 2, 3, 4, 5]) == []
-    assert pool.publish([1, 2, 3, 4, 5, 6, 7, 8], blocks, refs=0) == []
+    assert pool.publish([1, 2, 3, 4, 5, 6, 7, 8], blocks, refs=0) == ([], [])
     assert pool.cached_blocks == 0
     pool.free(blocks)  # still request-owned: publish was a no-op
 
@@ -380,11 +380,16 @@ def test_publish_duplicate_content_keeps_existing_copy():
     first = _seed_chain(pool, toks, refs=0)
     dup = pool.alloc(2)
     # Same content in different physical blocks: the trie keeps the
-    # existing copy, ours stays request-owned and frees normally.
-    assert pool.publish(toks, dup, refs=1) == []
+    # existing copy, ours stays request-owned and frees normally — and a
+    # live (refs>0) publish pins the traversed chain with one refcount
+    # per node, reported back for release at completion.
+    assert pool.publish(toks, dup, refs=1) == ([], first)
     assert pool.match(toks + [0]) == first
     assert pool.cached_blocks == 2
+    assert [pool._cached[b].refs for b in first] == [1, 1]
     pool.free(dup)
+    pool.release(first)  # what the publisher's completion does
+    assert pool.evictable_blocks == 2
 
 
 def test_release_and_free_guard_cached_blocks():
@@ -570,6 +575,82 @@ def test_admission_acquires_before_alloc_evicts():
     assert s.pool._cached[b.cached_blocks[0]].refs == 1
 
 
+def test_complete_withholds_pending_token_block():
+    # The completing token was sampled but never fed back through the
+    # model, so its KV slot is unwritten. On a block-aligned finish the
+    # last block must NOT be published: a continuation prompt (multi-turn
+    # history replay) matching it would attend to garbage KV.
+    s = _sched(slots=1, num_blocks=16, prefix_cache=True)
+    prompt = [1, 2, 3, 4]
+    s.submit(Request(prompt=list(prompt), max_new_tokens=4), now=0.0)
+    (st,) = _padmit(s, 0.0)
+    st.generated = [5, 6, 7, 8]  # len(seq) == 8: block-aligned finish
+    s.complete(st.slot, now=1.0)
+    seq = prompt + [5, 6, 7, 8]
+    # Only the fully-written first block is cached; the block holding the
+    # unwritten final-token KV is not.
+    assert s.pool.cached_blocks == 1
+    assert s.pool.match_len(seq + [9, 9]) == 4
+    # Off-alignment finish: every FULL block is fully written (only the
+    # partial tail holds the pending token) -> all full blocks publish.
+    s.submit(Request(prompt=list(range(10, 14)), max_new_tokens=5), now=2.0)
+    (st2,) = _padmit(s, 2.0)
+    st2.generated = [20, 21, 22, 23, 24]  # len(seq) == 9
+    s.complete(st2.slot, now=3.0)
+    assert s.pool.match_len(list(range(10, 14)) + st2.generated + [9]) == 8
+
+
+def test_same_wave_publish_through_shared_chain_pins_it():
+    # Two requests sharing a 2-block prefix admitted in the SAME wave: B
+    # matches nothing at admission (A hasn't published yet). A prefills
+    # and publishes the chain; B's publish then loses the content race
+    # and continues THROUGH A's nodes, hanging its own new block below
+    # them — taking one refcount per traversed node. Without those refs,
+    # A's completion would drop the interior nodes to refcount 0 under
+    # B's live child; evictable_blocks would then count blocks
+    # _evict_one can never reclaim, and allocation pressure would crash
+    # the engine instead of refusing.
+    s = _sched(slots=2, num_blocks=32, block_size=4, max_seq_len=32,
+               prefix_cache=True)
+    shared = list(range(1, 9))  # 2 full blocks
+    a_req = Request(prompt=shared + [9], max_new_tokens=2)
+    b_req = Request(prompt=shared + [20, 21, 22, 23, 24], max_new_tokens=2)
+    s.submit(a_req, now=0.0)
+    s.submit(b_req, now=0.0)
+    a, b = _padmit(s, 0.0)  # same wave: neither hits the trie
+    assert a.cached_blocks == [] and b.cached_blocks == []
+    s.publish_prefix(a, len(a_req.prompt))  # A publishes the 2 shared nodes
+    assert len(a.published) == 2 and a.trie_refs == []
+    s.publish_prefix(b, len(b_req.prompt))  # B chains through A's nodes
+    assert b.trie_refs == a.published       # traversal pinned A's chain
+    assert len(b.published) == 1            # tokens 20..23 hang below it
+    shared_nodes = list(a.published)
+    assert [s.pool._cached[n].refs for n in shared_nodes] == [2, 2]
+
+    a.generated = [30, 31]
+    s.complete(a.slot, now=1.0)
+    # A released its refs; B's traversal refs still pin the interior
+    # chain, so the refcount-0 set stays closed under descendants.
+    assert [s.pool._cached[n].refs for n in shared_nodes] == [1, 1]
+    for nd in s.pool._cached.values():
+        if nd.refs == 0:
+            assert all(s.pool._cached[c].refs == 0 for c in nd.children)
+    # Eviction pressure: every cached node is pinned, so nothing is
+    # reclaimable — alloc must refuse, not crash hunting for a leaf.
+    assert s.pool.evictable_blocks == 0
+    got = s.pool.alloc(s.pool.free_blocks)  # drain the free list exactly
+    assert got is not None
+    assert s.pool.alloc(1) is None
+    s.pool.free(got)
+
+    b.generated = [40, 41]
+    s.complete(b.slot, now=2.0)
+    assert s.pool.used_blocks == 0
+    assert s.pool.evictable_blocks == s.pool.cached_blocks
+    s.pool.flush_cache()
+    assert s.pool.cached_blocks == 0 and s.pool.free_blocks == 31
+
+
 def test_prefix_stats_and_gauges_shape():
     s = _sched(prefix_cache=True)
     assert "prefix_hit_rate" in s.gauges()
@@ -619,10 +700,19 @@ def test_no_block_leaks_with_prefix_cache_1k():
         assert s.pool.used_blocks == sum(
             len(st.blocks) - len(st.published) for st in s.active
         )
-        # Refcounts == live mappings (cached hits + own published blocks).
+        # Refcounts == live mappings (cached hits + own published blocks
+        # + chains our publish continued through).
         assert sum(nd.refs for nd in s.pool._cached.values()) == sum(
-            len(st.cached_blocks) + len(st.published) for st in s.active
+            len(st.cached_blocks) + len(st.published) + len(st.trie_refs)
+            for st in s.active
         )
+        # Eviction soundness: the refcount-0 set is closed under
+        # descendants, so every evictable count is actually reclaimable.
+        for b, nd in s.pool._cached.items():
+            if nd.refs == 0:
+                assert all(
+                    s.pool._cached[c].refs == 0 for c in nd.children
+                ), f"refcount-0 node {b} has a live child"
     assert s.pool.used_blocks == 0
     assert s.pool.evictable_blocks == s.pool.cached_blocks
     s.pool.flush_cache()
@@ -630,4 +720,4 @@ def test_no_block_leaks_with_prefix_cache_1k():
     assert s.pool.free_blocks == 31
     assert len(s.finished) == 1000
     for st in s.finished:
-        assert st.blocks == [] and st.published == []
+        assert st.blocks == [] and st.published == [] and st.trie_refs == []
