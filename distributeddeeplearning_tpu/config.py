@@ -384,6 +384,21 @@ class ServingConfig:
     # len(prompt_buckets) + len(suffix_buckets) + 1 (+1 with
     # speculation), still zero steady-state recompiles.
     suffix_buckets: tuple = ()
+    # KV memory hierarchy (prefix_cache only; docs/SERVING.md memory-
+    # hierarchy section): host-RAM budget, in BLOCKS, for evicted prefix
+    # KV. 0 = no host tier (eviction destroys, PR 15 behavior). > 0:
+    # eviction demotes the victim's KV to a host-side store instead —
+    # the trie node survives and admission matches through it; promotion
+    # re-uploads overlapped with the suffix prefill. The host ledger has
+    # its own LRU; its second eviction is final. Requires
+    # prefix_cache=true — fenced by name.
+    spill_blocks: int = 0
+    # Spill payload codec: 'fp' keeps the pool dtype bitwise (warm-vs-
+    # cold greedy parity stays exact), 'int8' block-quantizes through
+    # comms_quant (~4x more spilled tokens per host byte; promoted
+    # logits drift within the pinned tolerance — see BENCH_SERVING.json
+    # kv_hierarchy). Only meaningful with spill_blocks > 0 — fenced.
+    spill_codec: str = "fp"
     # Engine replication (serving/router.py; docs/SERVING.md router
     # section): number of identical ServingEngine replicas behind a
     # ReplicaRouter — in-process on CPU sim, one mesh/device group per

@@ -29,5 +29,6 @@ from .scheduler import (  # noqa: F401
     RequestState,
     Scheduler,
     blocks_for,
+    chain_digests,
     ngram_draft,
 )

@@ -73,6 +73,21 @@ full blocks are published at completion — EXCEPT the block holding the
 final sampled token, whose KV was never written (the token is sampled
 but never fed back), so a block-aligned finish withholds its last block
 rather than serve garbage KV to a continuation prompt.
+
+With ``serving.spill_blocks > 0`` the trie grows a HOST tier: eviction
+demotes a refcount-0 block's KV into ``_spill_store`` (host RAM, keyed
+by chain hash) instead of destroying it, coalesced into ONE
+``device_get`` per eviction batch. Admission matches straight through
+spilled nodes; the pool re-keys them onto fresh device blocks
+(``promote``) and ``_apply_promotions`` uploads the payload with
+``jax.device_put`` dispatched BEFORE the suffix prefill, so the
+host->device copy overlaps the prefill compute (the scatter lands in
+blocks below the row's ``seq_lens`` cursor, so published-immutability
+holds — same bytes, same positions). ``serving.spill_codec='int8'``
+spills through ``comms_quant.block_quantize`` (~4x more spilled tokens
+per byte; scales beside the payload); ``'fp'`` is bitwise-lossless so
+warm-vs-cold greedy parity stays exact. Everything here is EAGER jnp —
+no new compiled bodies, the compile pin above is unchanged.
 """
 
 from __future__ import annotations
@@ -83,6 +98,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..comms_quant import block_dequantize, block_quantize
 from ..generate import (
     _filter_logits, logits_at, prefill, decode_step, verify_step,
 )
@@ -95,6 +111,11 @@ from .scheduler import (
 
 _POOL_LEAVES = ("pool_key", "pool_value")
 _HOST_LEAVES = ("page_table", "seq_lens")
+
+# int8 spill codec quantization granularity (elements per scale), matching
+# comms_quant's gradient path: per-256-block absmax keeps the dequant
+# error bounded by ~1/127 of the block's dynamic range.
+_SPILL_QBLOCK = 256
 
 # Models validated for paged-cache serving. Everything else is fenced at
 # config time (check_serving_composition) rather than failing deep inside
@@ -201,6 +222,39 @@ def _check_prefix_cache(prefix_cache, suffix_buckets,
     return sb
 
 
+def _check_spill(spill_blocks, spill_codec, prefix_cache) -> int:
+    """The host-spill-tier composition fences (by name, config time),
+    shared by ``check_serving_composition`` and ``ServingEngine``.
+    Returns the validated spill budget (blocks)."""
+    sb = int(spill_blocks or 0)
+    if sb < 0:
+        raise ValueError(
+            f"serving.spill_blocks must be >= 0 (0 = no host tier), got "
+            f"{spill_blocks}"
+        )
+    codec = str(spill_codec or "fp")
+    if codec not in ("fp", "int8"):
+        raise ValueError(
+            f"serving.spill_codec must be 'fp' or 'int8', got "
+            f"{spill_codec!r}"
+        )
+    if sb and not prefix_cache:
+        raise ValueError(
+            f"serving.spill_blocks={sb} x prefix_cache=False: the host "
+            "tier stores evicted prefix-TRIE blocks, and without the trie "
+            "there is nothing to spill — set serving.prefix_cache=true or "
+            "spill_blocks=0"
+        )
+    if codec != "fp" and not sb:
+        raise ValueError(
+            "serving.spill_codec='int8' x spill_blocks=0: the codec only "
+            "shapes the host spill tier, which spill_blocks=0 disables — "
+            "a silently ignored knob is a config bug; set spill_blocks > 0 "
+            "or drop the codec"
+        )
+    return sb
+
+
 def check_serving_composition(cfg) -> None:
     """Config-time composition fences for ``serve`` (PR-5 style: fail BY
     NAME before any compile). ``cfg`` is the full Config."""
@@ -289,6 +343,10 @@ def check_serving_composition(cfg) -> None:
     _check_prefix_cache(
         prefix_on, getattr(s, "suffix_buckets", ()), buckets
     )
+    _check_spill(
+        getattr(s, "spill_blocks", 0), getattr(s, "spill_codec", "fp"),
+        prefix_on,
+    )
     if policy == "prefix_affinity" and not prefix_on:
         raise ValueError(
             "serving.router_policy='prefix_affinity' x prefix_cache=False: "
@@ -376,6 +434,21 @@ class ServingEngine:
         self._prefill_widths = tuple(
             sorted(set(self.buckets) | set(self.suffix_buckets))
         )
+        # Host spill tier (module docstring): budget in blocks + codec.
+        self.spill_blocks = _check_spill(
+            getattr(cfg, "spill_blocks", 0),
+            getattr(cfg, "spill_codec", "fp"), self.prefix_cache,
+        )
+        self.spill_codec = str(getattr(cfg, "spill_codec", "fp") or "fp")
+        if static_batching and self.spill_blocks:
+            raise NotImplementedError(
+                "serving.spill_blocks x static_batching (spill_codec="
+                f"{self.spill_codec!r}): the host tier exists to carry "
+                "warm prefixes ACROSS batches, and the static baseline "
+                "admits only into an empty engine — exactly the cross-"
+                "batch reuse it exists to exclude; benchmark spill "
+                "against the spill-off CONTINUOUS engine instead"
+            )
         if static_batching and self.prefix_cache:
             raise NotImplementedError(
                 "serving.prefix_cache x static_batching: the static "
@@ -475,10 +548,22 @@ class ServingEngine:
         )
 
         # --- host-side scheduler + per-lane operand rows ----------------
+        # Host spill store: chain hash -> ("fp"|"int8", per-pool-leaf
+        # payload). The pool stays jax-free; it hands eviction victims to
+        # _spill_out (one coalesced device_get per batch) and releases
+        # payloads through _spill_drop.
+        self._spill_store: dict[bytes, tuple] = {}
+        self.spill_stats = {
+            "spill_bytes": 0, "promote_bytes": 0,
+            "spill_transfers": 0, "promote_transfers": 0,
+        }
         self.scheduler = Scheduler(
             S,
             KVBlockPool(self.num_blocks, bs,
-                        prefix_cache=self.prefix_cache),
+                        prefix_cache=self.prefix_cache,
+                        spill_blocks=self.spill_blocks,
+                        spill_fn=self._spill_out,
+                        drop_fn=self._spill_drop),
             self.max_seq_len,
         )
         self._table = np.zeros((S, self.pages), np.int32)
@@ -552,6 +637,151 @@ class ServingEngine:
             ),
             self._cache, updated,
         )
+
+    # ------------------------------------------------------------------
+    # host spill tier (KV memory hierarchy, module docstring)
+    # ------------------------------------------------------------------
+
+    def _pool_leaves(self) -> list:
+        """The cache pytree's pool leaves in canonical flatten order —
+        the SAME order for spill capture and promote scatter, so payload
+        slot k always names the same per-layer key/value array."""
+        flat = jax.tree_util.tree_flatten_with_path(self._cache)[0]
+        return [
+            leaf for path, leaf in flat
+            if getattr(path[-1], "key", None) in _POOL_LEAVES
+        ]
+
+    def _spill_out(self, pairs: list[tuple[int, bytes]]) -> None:
+        """Pool eviction callback: capture the victims' device KV into the
+        host store BEFORE their blocks can be reused. ONE coalesced
+        ``device_get`` per eviction batch (a tuple transfer), however many
+        blocks one admission squeezed out. Safe synchronously: admission
+        is host-sequential, so nothing rewrites the blocks between the
+        pool's callback and the copy. fp payloads keep the pool dtype
+        bitwise; int8 quantizes per 256-element block with the scale
+        stored beside the payload."""
+        leaves = self._pool_leaves()
+        ids = np.asarray([b for b, _ in pairs], np.int32)
+        host = jax.device_get(tuple(leaf[ids] for leaf in leaves))
+        self.spill_stats["spill_transfers"] += 1
+        for i, (_, h) in enumerate(pairs):
+            rows = [np.asarray(arr[i]) for arr in host]
+            if self.spill_codec == "int8":
+                payload = []
+                nbytes = 0
+                for row in rows:
+                    flat = np.asarray(row, np.float32).reshape(-1)
+                    pad = (-flat.size) % _SPILL_QBLOCK
+                    if pad:
+                        flat = np.concatenate(
+                            [flat, np.zeros(pad, np.float32)]
+                        )
+                    q, s = block_quantize(
+                        jnp.asarray(flat), _SPILL_QBLOCK
+                    )
+                    q, s = np.asarray(q), np.asarray(s)
+                    payload.append((q, s))
+                    nbytes += q.nbytes + s.nbytes
+                self._spill_store[h] = ("int8", payload)
+            else:
+                nbytes = sum(r.nbytes for r in rows)
+                self._spill_store[h] = ("fp", rows)
+            self.spill_stats["spill_bytes"] += nbytes
+
+    def _spill_drop(self, chain_hash: bytes) -> None:
+        """Pool drop callback: a host node left the trie (final eviction,
+        adoption, flush) — release its payload."""
+        self._spill_store.pop(chain_hash, None)
+
+    def _apply_promotions(self, state: RequestState) -> None:
+        """Upload the spill-store payloads for ``state``'s promoted
+        blocks. The ``device_put`` dispatches FIRST — it is async, so the
+        host->device copies overlap the operand prep and suffix-prefill
+        dispatch that follow; the eager scatter is ordered behind the
+        copy by data dependency alone. Scattered rows land in blocks the
+        page table maps BELOW the row's ``seq_lens`` cursor with exactly
+        the bytes the trie published there (bitwise for fp), so
+        published-block immutability holds. Promoted nodes carry
+        refcount >= 1 (the admission acquired the chain), so they cannot
+        be re-spilled before this upload lands."""
+        pairs = state.promoted
+        if not pairs:
+            return
+        state.promoted = []
+        t0 = time.perf_counter()
+        payloads = []
+        for _, h in pairs:
+            codec, payload = self._spill_store.pop(h)
+            payloads.append(payload)
+        ids = jnp.asarray(np.asarray([b for b, _ in pairs], np.int32))
+        n = len(pairs)
+        uploads = []
+        nbytes = 0
+        n_leaves = len(payloads[0])
+        for j in range(n_leaves):
+            if codec == "int8":
+                qs = np.stack([p[j][0] for p in payloads])
+                ss = np.stack([p[j][1] for p in payloads])
+                up = (jax.device_put(qs), jax.device_put(ss))
+                nbytes += qs.nbytes + ss.nbytes
+            else:
+                rows = np.stack([p[j] for p in payloads])
+                up = jax.device_put(rows)
+                nbytes += rows.nbytes
+            uploads.append(up)
+        self.spill_stats["promote_bytes"] += nbytes
+        self.spill_stats["promote_transfers"] += 1
+        it = iter(uploads)
+
+        def scatter(path, leaf):
+            if getattr(path[-1], "key", None) not in _POOL_LEAVES:
+                return leaf
+            up = next(it)
+            if codec == "int8":
+                q, s = up
+                flat = block_dequantize(
+                    q.reshape(-1, _SPILL_QBLOCK), s.reshape(-1, 1)
+                )
+                row_elems = int(np.prod(leaf.shape[1:]))
+                rows = flat.reshape(n, -1)[:, :row_elems].reshape(
+                    (n,) + leaf.shape[1:]
+                )
+            else:
+                rows = up
+            return leaf.at[ids].set(rows.astype(leaf.dtype))
+
+        self._cache = jax.tree_util.tree_map_with_path(
+            scatter, self._cache
+        )
+        # Dispatch wait, not completion wait: the copy+scatter run behind
+        # the suffix prefill; PR 12's fleet merge aggregates this per
+        # replica.
+        self._tel.hist("promote_wait").record(time.perf_counter() - t0)
+
+    def constrain_pool(self, num_blocks: int) -> None:
+        """Rebuild the pool with ``num_blocks <= self.num_blocks`` usable
+        entries (bench/test hook: sizes the DEVICE pool below a trace's
+        prefix working set so eviction/spill pressure is real without a
+        tiny HBM budget). Only legal on an idle engine — live requests
+        hold block ids the new pool would re-issue. The spill store is
+        cleared with the trie."""
+        if self.scheduler.active or self.scheduler.pending:
+            raise RuntimeError(
+                "constrain_pool with requests queued or in flight"
+            )
+        if not 2 <= num_blocks <= self.num_blocks:
+            raise ValueError(
+                f"constrain_pool({num_blocks}): need 2 <= n <= "
+                f"{self.num_blocks} (the allocated pool)"
+            )
+        self.scheduler.pool = KVBlockPool(
+            num_blocks, self.block_size,
+            prefix_cache=self.prefix_cache,
+            spill_blocks=self.spill_blocks,
+            spill_fn=self._spill_out, drop_fn=self._spill_drop,
+        )
+        self._spill_store.clear()
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -722,6 +952,14 @@ class ServingEngine:
         degenerates to least-loaded)."""
         return self.scheduler.pool.match_len(list(prompt))
 
+    def prefix_match_digests(self, digests: list[bytes]) -> int:
+        """Cached-token count from PRE-HASHED chain digests
+        (``scheduler.chain_digests``) — the router computes the chain
+        once per request and probes every replica with it, so probe cost
+        is O(prompt) hashing total instead of O(replicas x prompt).
+        Matches through the host tier, like admission."""
+        return self.scheduler.pool.match_digests(digests) * self.block_size
+
     def drain(self) -> None:
         """Graceful shutdown intake cut (the router's elastic-membership
         primitive, docs/SERVING.md): everything already accepted — queued
@@ -805,6 +1043,11 @@ class ServingEngine:
 
     def _admit_one(self, state: RequestState):
         req, slot = state.request, state.slot
+        # Promote FIRST (before the decode-route branch too — a full-
+        # prefix hit can ride through spilled nodes): the device_put
+        # inside dispatches async and overlaps everything below, through
+        # the suffix-prefill dispatch.
+        self._apply_promotions(state)
         row = np.zeros((self.pages,), np.int32)
         chain = state.cached_blocks + state.blocks  # logical block order
         row[: len(chain)] = chain
@@ -1086,7 +1329,7 @@ class ServingEngine:
         )
 
     def stats(self) -> dict:
-        return {
+        out = {
             **self.scheduler.stats(),
             "num_blocks": self.num_blocks,
             "block_bytes": self.block_bytes,
@@ -1114,3 +1357,10 @@ class ServingEngine:
                 ),
             },
         }
+        if self.prefix_cache and self.spill_blocks:
+            out["prefix_cache"].update({
+                "spill_codec": self.spill_codec,
+                "spill_store_blocks": len(self._spill_store),
+                **self.spill_stats,
+            })
+        return out

@@ -68,7 +68,7 @@ import time
 from ..metrics import event_record, serving_event
 from ..telemetry import NULL_TELEMETRY, Telemetry
 from .engine import ROUTER_POLICIES, SHED_POLICIES, ServingEngine
-from .scheduler import Request, RequestState
+from .scheduler import Request, RequestState, chain_digests
 
 
 class RequestShed(RuntimeError):
@@ -223,12 +223,18 @@ class ReplicaRouter:
             return loads[r.index]
 
         if self.policy == "prefix_affinity" and request is not None:
-            # Probe every live replica's trie digest (read-only hash
-            # walk). Max cached-prefix length wins; among equals the
+            # Probe every live replica's trie (read-only). The chain
+            # digests are hashed ONCE here and handed to every probe, so
+            # dispatch costs O(prompt) hashing instead of O(replicas x
+            # prompt) — replicas share a block size, so one digest chain
+            # fits all. Max cached-prefix length wins; among equals the
             # least-loaded key tie-breaks, so N replicas holding the same
             # hot prefix still spread its traffic.
+            digests = chain_digests(
+                list(request.prompt), live[0].engine.block_size
+            )
             matches = [
-                (r.engine.prefix_match_len(request.prompt), r)
+                (r.engine.prefix_match_digests(digests), r)
                 for r in live
             ]
             best = max(m for m, _ in matches)

@@ -26,6 +26,15 @@ Design (docs/SERVING.md):
   capacity = free list + evictable cache. A block is in exactly one of three states: free, request-owned
   (``_allocated``), or cached (``_cached``) — conservation over the three
   is a tested invariant.
+- **Host spill tier** (``spill_blocks > 0``) — eviction demotes instead
+  of destroys: the victim's trie node survives with a negative host id
+  and no device block while the engine parks its KV in host RAM (the
+  pool stays jax-free via ``spill_fn``/``drop_fn`` callbacks). Admission
+  matches straight through spilled nodes; ``promote`` re-keys them onto
+  fresh device blocks and the engine uploads the payload. The device
+  conservation invariant is unchanged (``used + free + cached_device ==
+  num_blocks - 1``); the host ledger is separate, capped by
+  ``spill_blocks`` with its own LRU — the second eviction is final.
 - **Scheduler** — FIFO admission into ``slots`` decode lanes. A queued
   request is admitted when a lane is free AND the pool can hold its whole
   worst-case sequence (prompt bucket + ``max_new_tokens``, rounded up to
@@ -99,6 +108,28 @@ def ngram_draft(tokens: list[int], k: int, *, max_ngram: int = 3,
 _ROOT_HASH = b""  # chain hash of the empty prefix (the trie root)
 
 
+def chain_digests(tokens, block_size: int) -> list[bytes]:
+    """Chain hashes of every full block covering a strict prefix of
+    ``tokens`` — the same cap as :meth:`KVBlockPool.match` (at least one
+    token is always left to compute), so ``match_digests(chain_digests(
+    t, bs))`` equals ``len(match(t))`` on any pool with that block size.
+
+    Computed ONCE per request at the router and passed to every replica
+    probe: O(prompt) hashing total instead of O(replicas x prompt) when
+    each replica re-chains the prompt itself."""
+    if not tokens:
+        return []
+    n_full = (len(tokens) - 1) // block_size
+    out: list[bytes] = []
+    parent = _ROOT_HASH
+    for k in range(n_full):
+        parent = _block_hash(
+            parent, tokens[k * block_size:(k + 1) * block_size]
+        )
+        out.append(parent)
+    return out
+
+
 def _block_hash(parent_hash: bytes, tokens) -> bytes:
     """Chain hash of one full block: ``H(parent_hash, block_token_ids)``.
 
@@ -149,7 +180,8 @@ class KVBlockPool:
     NULL_BLOCK = 0
 
     def __init__(self, num_blocks: int, block_size: int, *,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, spill_blocks: int = 0,
+                 spill_fn=None, drop_fn=None):
         if num_blocks < 2:
             raise ValueError(
                 f"KV pool needs >= 2 blocks (1 null + 1 usable), got "
@@ -158,20 +190,51 @@ class KVBlockPool:
             )
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if spill_blocks < 0:
+            raise ValueError(
+                f"serving.spill_blocks must be >= 0, got {spill_blocks}"
+            )
+        if spill_blocks and not prefix_cache:
+            raise ValueError(
+                "spill_blocks > 0 with prefix_cache=False — the host tier "
+                "stores evicted TRIE nodes; without the trie there is "
+                "nothing to spill. Set serving.prefix_cache=True or "
+                "spill_blocks=0."
+            )
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.prefix_cache = bool(prefix_cache)
+        self.spill_blocks = int(spill_blocks)
+        # Host-tier callbacks (the engine wires these; pure-Python pool
+        # stays jax-free): ``spill_fn(pairs)`` receives
+        # ``[(block_id, chain_hash), ...]`` BEFORE any spilled block can be
+        # reused — the engine must capture the device KV then;
+        # ``drop_fn(chain_hash)`` releases the host payload when a host
+        # node leaves the trie (final eviction, promotion-by-adoption,
+        # flush).
+        self._spill_fn = spill_fn
+        self._drop_fn = drop_fn
         # LIFO free list: recently-freed (cache-warm) blocks are reused
         # first, and page-table reuse after completion is deterministic.
         self._free = list(range(num_blocks - 1, 0, -1))
         self._allocated: set[int] = set()
         self.high_water = 0
         # Prefix trie state (empty and inert when prefix_cache is off).
-        self._cached: dict[int, _PrefixNode] = {}   # block id -> node
-        self._by_hash: dict[bytes, int] = {}        # chain hash -> block id
+        # Node ids span two tiers: POSITIVE ids are device blocks (they
+        # index the paged pool); NEGATIVE ids are host-tier nodes whose KV
+        # lives in the engine's spill store, keyed by chain hash. _by_hash
+        # spans both tiers, so match() walks through spilled nodes for
+        # free.
+        self._cached: dict[int, _PrefixNode] = {}   # node id -> node
+        self._by_hash: dict[bytes, int] = {}        # chain hash -> node id
+        self._next_hid = -1                         # next host-tier id
         self._tick = 0
         self.evictions = 0
         self.published_total = 0
+        self.spills = 0
+        self.promotes = 0
+        self.adoptions = 0
+        self.final_evictions = 0
 
     @property
     def free_blocks(self) -> int:
@@ -183,12 +246,23 @@ class KVBlockPool:
 
     @property
     def cached_blocks(self) -> int:
-        return len(self._cached)
+        """Device-tier cache nodes (each holds one physical block)."""
+        return sum(1 for b in self._cached if b > 0)
+
+    @property
+    def spilled_blocks(self) -> int:
+        """Host-tier cache nodes (KV in the engine's spill store, no
+        device block) — the spilled ledger, capped by ``spill_blocks``."""
+        return sum(1 for b in self._cached if b < 0)
 
     @property
     def evictable_blocks(self) -> int:
-        """Cache nodes no live request maps (refcount 0) — reclaimable."""
-        return sum(1 for nd in self._cached.values() if nd.refs == 0)
+        """Device cache nodes no live request maps (refcount 0) —
+        reclaimable by ``alloc`` (spilled to host when the budget allows,
+        dropped otherwise). Host nodes never back a reservation."""
+        return sum(
+            1 for b, nd in self._cached.items() if b > 0 and nd.refs == 0
+        )
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free) + self.evictable_blocks
@@ -198,8 +272,14 @@ class KVBlockPool:
             raise ValueError(f"alloc({n})")
         if not self.can_alloc(n):
             return None
+        spill_batch: list[tuple[int, bytes]] = []
         while len(self._free) < n:
-            self._evict_one()
+            self._evict_one(spill_batch)
+        if spill_batch and self._spill_fn is not None:
+            # One callback per eviction BATCH (the engine coalesces it
+            # into a single device_get), before any freed block is popped
+            # for reuse — the KV is still intact on device here.
+            self._spill_fn(spill_batch)
         got = [self._free.pop() for _ in range(n)]
         self._allocated.update(got)
         self.high_water = max(self.high_water, len(self._allocated))
@@ -242,6 +322,21 @@ class KVBlockPool:
         """Tokens of ``tokens`` whose KV is already cached (the replica
         trie digest ``prefix_affinity`` routing scores against)."""
         return len(self.match(tokens)) * self.block_size
+
+    def match_digests(self, digests: list[bytes]) -> int:
+        """Count of leading ``digests`` present in the trie (either tier)
+        — the pre-hashed probe the router uses so chain hashing happens
+        once per request instead of once per replica. Equals
+        ``len(match(tokens))`` when ``digests = chain_digests(tokens,
+        block_size)``. Read-only, like :meth:`match`."""
+        if not self.prefix_cache:
+            return 0
+        n = 0
+        for d in digests:
+            if d not in self._by_hash:
+                break
+            n += 1
+        return n
 
     def acquire(self, blocks: list[int]) -> None:
         """Map cached blocks into a request: refcount+1 and LRU-touch the
@@ -298,6 +393,36 @@ class KVBlockPool:
             chunk = tokens[k * self.block_size:(k + 1) * self.block_size]
             parent_hash = _block_hash(parent_hash, chunk)
             existing = self._by_hash.get(parent_hash)
+            if existing is not None and existing < 0:
+                # HOST-tier hit: the publisher holds a freshly-written
+                # device copy of exactly this block's KV, so the node
+                # ADOPTS it — re-keyed onto our block, a free promotion
+                # with no host->device upload. The host payload is
+                # redundant and dropped. Root-down walk order means a
+                # parent is adopted (made device) before its child, so
+                # the adopted node's parent is already device-tier.
+                if b not in self._allocated:
+                    raise ValueError(f"publishing unowned block {b}")
+                self._allocated.remove(b)
+                nd = self._cached.pop(existing)
+                self._cached[b] = nd
+                self._by_hash[parent_hash] = b
+                if nd.parent is not None:
+                    p = self._cached[nd.parent]
+                    p.children.discard(existing)
+                    p.children.add(b)
+                for c in nd.children:
+                    self._cached[c].parent = b
+                nd.last_use = self._tick
+                if refs > 0:
+                    nd.refs += 1
+                self.adoptions += 1
+                if self._drop_fn is not None:
+                    self._drop_fn(parent_hash)
+                published.append(b)
+                self.published_total += 1
+                parent_block = b
+                continue
             if existing is not None:
                 # Already cached (possibly by us, possibly a duplicate in
                 # another block) — the chain continues through the cached
@@ -324,16 +449,52 @@ class KVBlockPool:
         return published, traversed
 
     def _drop_node(self, b: int) -> None:
-        """Remove one childless cache node and return its block to the
-        free list."""
+        """Remove one childless cache node. Device nodes (b > 0) return
+        their block to the free list; host nodes (b < 0) release their
+        spill-store payload via ``drop_fn`` instead — no device block to
+        return."""
         nd = self._cached.pop(b)
         if nd.children:
             raise ValueError(f"dropping cache node {b} with children")
         del self._by_hash[nd.chain_hash]
         if nd.parent is not None:
             self._cached[nd.parent].children.discard(b)
-        self._free.append(b)
-        self.evictions += 1
+        if b > 0:
+            self._free.append(b)
+            self.evictions += 1
+        elif self._drop_fn is not None:
+            self._drop_fn(nd.chain_hash)
+
+    def promote(self, host_ids: list[int],
+                blocks: list[int]) -> list[tuple[int, bytes]]:
+        """Re-key host-tier nodes onto freshly-allocated device blocks
+        (``host_ids[k]`` -> ``blocks[k]``, chain order: a parent promotes
+        before its child, keeping host subtrees strictly below device
+        nodes). The caller owns ``blocks`` via ``alloc`` and must have
+        ``acquire``d the matched chain first, so a promoted node carries
+        refcount >= 1 and cannot be re-spilled before its KV upload lands.
+        Returns ``[(block_id, chain_hash), ...]`` — the engine uploads the
+        spill-store payload for each hash into its block, then drops the
+        host copy."""
+        out: list[tuple[int, bytes]] = []
+        for h, b in zip(host_ids, blocks):
+            if h >= 0:
+                raise ValueError(f"promoting device-tier node {h}")
+            if b not in self._allocated:
+                raise ValueError(f"promoting onto unowned block {b}")
+            self._allocated.remove(b)
+            nd = self._cached.pop(h)
+            self._cached[b] = nd
+            self._by_hash[nd.chain_hash] = b
+            if nd.parent is not None:
+                p = self._cached[nd.parent]
+                p.children.discard(h)
+                p.children.add(b)
+            for c in nd.children:
+                self._cached[c].parent = b
+            self.promotes += 1
+            out.append((b, nd.chain_hash))
+        return out
 
     def evict_subtree(self, b: int) -> list[int]:
         """Evict cache node ``b`` AND its whole subtree (deepest first), so
@@ -358,16 +519,29 @@ class KVBlockPool:
             self._drop_node(cur)
         return order
 
-    def _evict_one(self) -> None:
-        """Reclaim the LRU refcount-0 LEAF. One always exists when
-        ``evictable_blocks > 0``: a request acquires/publishes whole
-        chains from the root, so a refcount>0 child implies a refcount>0
-        parent — the refcount-0 set is closed under descendants and its
-        deepest members are trie leaves. Ties break on block id, so the
-        order is fully deterministic under the logical clock."""
+    def _evict_one(self, spill_batch: list | None = None) -> None:
+        """Reclaim the LRU refcount-0 device node with no DEVICE children.
+        One always exists when ``evictable_blocks > 0``: a request
+        acquires/publishes whole chains from the root, so a refcount>0
+        child implies a refcount>0 parent — the refcount-0 set is closed
+        under descendants, and its deepest DEVICE member has only host
+        children (if any). Ties break on block id, so the order is fully
+        deterministic under the logical clock.
+
+        With ``spill_blocks == 0`` the victim is dropped (PR 15
+        behavior). Otherwise it is SPILLED: the trie node survives,
+        re-keyed onto a fresh negative host id, its device block returns
+        to the free list, and ``(block, chain_hash)`` is appended to
+        ``spill_batch`` (or ``spill_fn`` is called immediately when no
+        batch is given) so the engine captures the KV before reuse. When
+        the host ledger is at budget, the LRU refcount-0 host LEAF is
+        final-evicted first — the second eviction is final; ties break on
+        earliest-spilled (smallest ``-h``)."""
         best = None
         for b, nd in self._cached.items():
-            if nd.refs == 0 and not nd.children:
+            if b > 0 and nd.refs == 0 and not any(
+                c > 0 for c in nd.children
+            ):
                 key = (nd.last_use, b)
                 if best is None or key < best:
                     best = key
@@ -376,16 +550,85 @@ class KVBlockPool:
                 "eviction requested with no refcount-0 leaf — refcount "
                 "chain invariant violated"
             )
-        self._drop_node(best[1])
+        b = best[1]
+        if not self.spill_blocks:
+            self._drop_node(b)
+            return
+        if self.spilled_blocks >= self.spill_blocks:
+            h_best = None
+            for h, nd in self._cached.items():
+                if h < 0 and nd.refs == 0 and not nd.children:
+                    key = (nd.last_use, -h)
+                    if h_best is None or key < h_best:
+                        h_best = key
+            if h_best is None:
+                # Ledger full of pinned/interior nodes only — cannot
+                # happen in steady state (host nodes carry refcount 0 and
+                # host fringes always have a leaf), but drop the device
+                # victim outright rather than wedge.
+                self._drop_node(b)
+                return
+            hb = -h_best[1]
+            nd_h = self._cached[hb]
+            cancelled = False
+            if spill_batch is not None:
+                # The victim may have been spilled EARLIER IN THIS SAME
+                # alloc: its KV capture is still pending in the batch.
+                # Cancel the capture instead of dropping — calling
+                # drop_fn before spill_fn ran would release a payload
+                # that doesn't exist yet, and the deferred capture would
+                # then park a stale orphan in the store.
+                for i, (_, bh) in enumerate(spill_batch):
+                    if bh == nd_h.chain_hash:
+                        del spill_batch[i]
+                        cancelled = True
+                        break
+            if cancelled:
+                self._cached.pop(hb)
+                del self._by_hash[nd_h.chain_hash]
+                if nd_h.parent is not None:
+                    self._cached[nd_h.parent].children.discard(hb)
+            else:
+                self._drop_node(hb)
+            self.final_evictions += 1
+        # Spill: the node survives on the host tier; the block is freed.
+        nd = self._cached.pop(b)
+        h = self._next_hid
+        self._next_hid -= 1
+        self._cached[h] = nd
+        self._by_hash[nd.chain_hash] = h
+        if nd.parent is not None:
+            p = self._cached[nd.parent]
+            p.children.discard(b)
+            p.children.add(h)
+        for c in nd.children:
+            self._cached[c].parent = h
+        self._free.append(b)
+        self.evictions += 1
+        self.spills += 1
+        if spill_batch is not None:
+            spill_batch.append((b, nd.chain_hash))
+        elif self._spill_fn is not None:
+            self._spill_fn([(b, nd.chain_hash)])
 
     def flush_cache(self) -> int:
-        """Evict every refcount-0 cache node (leaf-first); returns the
-        count. With no live requests this empties the trie entirely — the
-        leak check's end state."""
+        """Drop every refcount-0 cache node in BOTH tiers (leaf-first,
+        ``(last_use, id)`` order — no spilling: a flush is a teardown,
+        not memory pressure); returns the count. With no live requests
+        this empties the trie and, via ``drop_fn``, the engine's spill
+        store — the leak check's end state."""
         n = 0
-        while self.evictable_blocks:
-            self._evict_one()
-            n += 1
+        while True:
+            victims = [
+                b for b, nd in self._cached.items()
+                if nd.refs == 0 and not nd.children
+            ]
+            if not victims:
+                break
+            victims.sort(key=lambda b: (self._cached[b].last_use, b))
+            for b in victims:
+                self._drop_node(b)
+                n += 1
         return n
 
 
@@ -426,6 +669,12 @@ class RequestState:
     cached_len: int = 0
     published: list[int] = dataclasses.field(default_factory=list)
     trie_refs: list[int] = dataclasses.field(default_factory=list)
+    # Host-tier nodes promoted at admission: ``(device_block, chain_hash)``
+    # pairs whose KV the engine must upload from its spill store before
+    # this request's first forward pass (cleared once applied).
+    promoted: list[tuple[int, bytes]] = dataclasses.field(
+        default_factory=list
+    )
     decode_route: bool = False
     slot: int = -1
     generated: list[int] = dataclasses.field(default_factory=list)
@@ -490,6 +739,7 @@ class Scheduler:
         # that skipped prefill entirely.
         self.prefix_hit_tokens = 0
         self.prefix_miss_tokens = 0
+        self.prefix_hit_tokens_host = 0  # subset served via host promote
         self.decode_route_admits = 0
 
     # -- intake ------------------------------------------------------------
@@ -588,20 +838,38 @@ class Scheduler:
             else:
                 bucket = bucket_of(plen)
                 cover = bucket
+            # Host-tier hits occupy no device block yet, so the
+            # reservation must cover them too: they are promoted onto
+            # fresh device blocks right after alloc. Host nodes are a
+            # SUFFIX of the matched chain (a device node's parent is
+            # never host), so counting trailing negatives is exact.
+            n_host = sum(1 for c in cached if c < 0)
             need = blocks_for(
                 max(cover, plen + req.max_new_tokens), bs
-            ) - len(cached)
+            ) - (len(cached) - n_host)
             # Acquire BEFORE alloc: alloc may evict refcount-0 trie nodes,
-            # and the matched chain must survive it.
+            # and the matched chain must survive it. Acquiring host nodes
+            # also pins them (refcount > 0) against final eviction while
+            # our own alloc squeezes the spill ledger.
             self.pool.acquire(cached)
             blocks = self.pool.alloc(need)
             if blocks is None:
                 self.pool.release(cached)
                 break
+            promoted: list[tuple[int, bytes]] = []
+            if n_host:
+                host_ids = cached[len(cached) - n_host:]
+                promoted = self.pool.promote(host_ids, blocks[:n_host])
+                remap = dict(zip(host_ids, (b for b, _ in promoted)))
+                cached = cached[:len(cached) - n_host] + [
+                    remap[h] for h in host_ids
+                ]
+                blocks = blocks[n_host:]
             self.pending.popleft()
             state.bucket = bucket
             state.blocks = blocks
             state.cached_blocks = cached
+            state.promoted = promoted
             state.cached_len = cached_len
             state.decode_route = decode_route
             state.slot = slot
@@ -611,6 +879,7 @@ class Scheduler:
             if self.pool.prefix_cache:
                 self.prefix_hit_tokens += cached_len
                 self.prefix_miss_tokens += plen - cached_len
+                self.prefix_hit_tokens_host += n_host * bs
                 self.decode_route_admits += int(decode_route)
             placed.append(state)
         return placed
@@ -713,12 +982,22 @@ class Scheduler:
             out["prefix_cache"] = {
                 "hit_tokens": self.prefix_hit_tokens,
                 "miss_tokens": self.prefix_miss_tokens,
+                "hit_tokens_host": self.prefix_hit_tokens_host,
+                "hit_tokens_device": (
+                    self.prefix_hit_tokens - self.prefix_hit_tokens_host
+                ),
                 "hit_rate": round(self.prefix_hit_rate(), 6),
                 "decode_route_admits": self.decode_route_admits,
                 "cached_blocks": self.pool.cached_blocks,
                 "evictable_blocks": self.pool.evictable_blocks,
                 "published_total": self.pool.published_total,
                 "evictions": self.pool.evictions,
+                "spill_budget": self.pool.spill_blocks,
+                "spilled_blocks": self.pool.spilled_blocks,
+                "spills": self.pool.spills,
+                "promotes": self.pool.promotes,
+                "adoptions": self.pool.adoptions,
+                "final_evictions": self.pool.final_evictions,
             }
         return out
 
@@ -749,6 +1028,12 @@ class Scheduler:
         }
         if self.pool.prefix_cache:
             g["prefix_hit_rate"] = round(self.prefix_hit_rate(), 6)
+            # Cache-pressure gauges: least-loaded and prefix-affinity
+            # scoring (and the fleet gauge merge) read these to see how
+            # much of the pool is warm cache vs reclaimable vs spilled.
+            g["cached_blocks"] = self.pool.cached_blocks
+            g["evictable_blocks"] = self.pool.evictable_blocks
+            g["spilled_blocks"] = self.pool.spilled_blocks
         if now is not None:
             g["oldest_queued_age_s"] = (
                 now - self.pending[0].arrival_s if self.pending else 0.0

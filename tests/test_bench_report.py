@@ -109,6 +109,11 @@ def test_report_on_repo_root(tmp_path):
         assert sv["prefix_prefill_token_reduction_shared"] >= 2.0
         assert 0.0 <= sv["prefix_adversarial_hit_rate"] <= 0.01
         assert sv["prefix_tokens_match_cache_off_shared"] is True
+        # ... and the kv-hierarchy capacity headline rides along.
+        assert sv["kv_hit_token_recovery_spill_fp"] >= 2.0
+        assert sv["kv_tokens_match_spill_off"] is True
+        assert sv["kv_int8_adversarial_hit_rate"] == 0.0
+        assert 0.0 <= sv["kv_int8_max_rel_drift"] <= 0.05
 
 
 def test_committed_trajectory_artifact():

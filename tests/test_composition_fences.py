@@ -664,6 +664,17 @@ def test_router_rejects_static_batching_by_name():
      "prefix_affinity.*prefix_cache=False"),
     (dict(replicas=2, router_policy="prefix_affinity"), ValueError,
      "prefix_affinity.*prefix_cache=False"),
+    # spill tier hangs off the trie: no trie, nothing to spill — fail
+    # loudly instead of silently ignoring the budget
+    (dict(spill_blocks=4), ValueError,
+     "spill_blocks.*prefix_cache=False"),
+    (dict(prefix_cache=True, spill_blocks=-1), ValueError,
+     "spill_blocks must be >= 0"),
+    (dict(prefix_cache=True, spill_blocks=4, spill_codec="nvfp4"),
+     ValueError, "spill_codec"),
+    # a codec with no spill budget is a silently-ignored knob: config bug
+    (dict(prefix_cache=True, spill_codec="int8"), ValueError,
+     "spill_blocks=0"),
 ])
 def test_prefix_cache_fence_matrix(kwargs, err, match):
     from distributeddeeplearning_tpu.config import (
@@ -696,6 +707,16 @@ def test_prefix_cache_fence_matrix(kwargs, err, match):
     # parity proof is test_serving_prefix.py::
     # test_sampled_requests_sharing_a_prefix_are_legal.
     dict(prefix_cache=True, suffix_buckets=(4,)),
+    # the spill tier composes with everything the trie composes with;
+    # fp parity and the int8 bar are pinned live in
+    # tests/test_serving_spill.py.
+    dict(prefix_cache=True, spill_blocks=4),
+    dict(prefix_cache=True, suffix_buckets=(4,), spill_blocks=4,
+         spill_codec="int8"),
+    dict(prefix_cache=True, suffix_buckets=(4,), spill_blocks=4,
+         speculation="ngram:3"),
+    dict(replicas=3, prefix_cache=True, spill_blocks=4,
+         router_policy="prefix_affinity"),
 ])
 def test_prefix_cache_legal_compositions_pass(kwargs):
     from distributeddeeplearning_tpu.config import (

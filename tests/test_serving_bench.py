@@ -33,6 +33,15 @@ control. The smoke leg checks shape, token parity on both traces, the
 counter conservation (hit + miss == prompt tokens), and the widened
 compile pin; the headline RATIOS (>= 2x prefill-token reduction,
 improved p50 TTFT) are pinned on the committed full-load artifact.
+
+PR 16 adds the ``kv_hierarchy`` block: the shared-prefix workload at
+more system prompts than the constrained device pool can cache, served
+spill-off / spill-fp / spill-fp-tight / spill-int8 plus the int8
+adversarial control and a measured int8 promote logit probe. The smoke
+leg checks per-row tier conservation, fp token parity (incl. under the
+tight host budget), the exactly-0.0 adversarial hit rate, and the
+unchanged compile pin; the >= 2x hit-token recovery headline is pinned
+on the committed artifact.
 """
 
 import json
@@ -134,6 +143,7 @@ def _check_shape(rec, n_requests):
     assert sc["spec_decode_tps_ratio"] > 0
     _check_router_shape(rec)
     _check_prefix_shape(rec)
+    _check_kv_shape(rec)
 
 
 def _check_prefix_shape(rec):
@@ -159,6 +169,55 @@ def _check_prefix_shape(rec):
     # Unique random prompts cannot hit: the control reports ~0 honestly.
     assert comp["adversarial_hit_rate"] <= 0.01
     assert comp["zero_recompiles_with_cache"] is True
+
+
+def _check_kv_shape(rec):
+    kv = rec["kv_hierarchy"]
+    assert kv["device_blocks"] > 0
+    assert kv["spill_blocks"] > kv["tight_spill_blocks"] > 0
+    off, fp, tight, int8, adv = kv["rows"]
+    comp = kv["comparison"]
+    # The baseline row runs the SAME constrained pool with no spill tier.
+    assert off["prefix"]["spill_budget"] == 0
+    assert "spill_bytes" not in off["prefix"]
+    for row, budget in ((fp, kv["spill_blocks"]),
+                        (tight, kv["tight_spill_blocks"]),
+                        (int8, kv["spill_blocks"]),
+                        (adv, kv["spill_blocks"])):
+        p = row["prefix"]
+        assert row["constrained_blocks"] == kv["device_blocks"]
+        assert p["spill_budget"] == budget
+        # The host ledger never exceeds its budget, and the engine-side
+        # payload store tracks it exactly.
+        assert 0 <= p["spilled_blocks"] <= budget
+        assert p["spill_store_blocks"] == p["spilled_blocks"]
+        # Tier split: every trie hit token came from exactly one tier.
+        assert (p["hit_tokens_host"] + p["hit_tokens_device"]
+                == p["hit_tokens"])
+        assert p["hit_tokens"] + p["miss_tokens"] == row["prompt_tokens"]
+        # Spill/promote are eager transfers, not programs: the prefix
+        # compile pin is unchanged and nothing compiles after warmup.
+        assert (row["compiles_after_run"] == row["compiles_warmup"]
+                == comp["compile_pin"])
+    assert fp["prefix"]["spill_codec"] == "fp"
+    assert int8["prefix"]["spill_codec"] == "int8"
+    # The hierarchy actually cycled on the spill rows: blocks went to
+    # host, came back, and fed warm admissions.
+    assert comp["promotes_spill_fp"] > 0
+    assert comp["hit_tokens_host_spill_fp"] > 0
+    assert comp["final_evictions_under_tight_budget"] > 0
+    # fp payloads are bitwise: parity even when the tight budget drops
+    # prefixes back to cold mid-trace.
+    assert comp["tokens_match_spill_off"] is True
+    assert comp["tokens_match_spill_off_tight"] is True
+    # The int8 control: unique random prompts, hit rate exactly 0.0 —
+    # the codec can lose precision only on KV a warm request reuses,
+    # never manufacture reuse.
+    assert comp["int8_adversarial_hit_rate"] == 0.0
+    probe = comp["int8_logit_probe"]
+    assert probe["ok"] is True
+    assert probe["max_rel_drift"] <= probe["tolerance"]
+    assert comp["zero_recompiles_with_spill"] is True
 
 
 def _check_router_shape(rec):
@@ -262,3 +321,10 @@ def test_bench_serving_artifact():
     assert pxc["prefill_token_reduction_shared"] >= 2.0
     assert pxc["p50_ttft_improved_shared"] is True
     assert 0.0 < pxc["shared_hit_rate"] < 1.0
+    # KV-hierarchy headline (the constrained-pool trace): the spill tier
+    # must recover at least 2x the prefix hit tokens the bare device
+    # pool retains, with the spill path actually cycling.
+    kvc = rec["kv_hierarchy"]["comparison"]
+    assert kvc["hit_token_recovery_spill_fp"] >= 2.0
+    assert kvc["spills_spill_fp"] > 0
+    assert kvc["int8_promotes"] > 0

@@ -421,3 +421,59 @@ def test_prefix_affinity_quarantine_reroutes_to_cold_survivor():
     assert router.stats()["rerouted"] == 2
     assert all(v == 1 - home
                for k, v in router.routes.items() if k > 0)
+
+
+def test_chain_digests_equal_trie_match_semantics():
+    # The router's probe currency: hashing the prompt once via
+    # chain_digests and counting leading digests in a trie must agree
+    # with the pool's own token-walk match, for full hits, partial hits,
+    # first-block misses, and the sub-block-length degenerate case.
+    from distributeddeeplearning_tpu.serving import (
+        KVBlockPool, chain_digests,
+    )
+
+    pool = KVBlockPool(16, 4, prefix_cache=True)
+    toks = list(range(1, 13))
+    blocks = pool.alloc(3)
+    pool.publish(toks, blocks, refs=0)
+    for probe in (toks + [99], toks[:8] + [55], [42] + toks,
+                  toks[:3], toks):
+        digests = chain_digests(probe, 4)
+        assert pool.match_digests(digests) * 4 == pool.match_len(probe), \
+            probe
+    # The chain caps at (len-1)//block_size: a full-block-aligned probe
+    # never hashes its own last block (it can't be a strict prefix hit).
+    assert len(chain_digests(toks, 4)) == 2
+    assert chain_digests([], 4) == []
+
+
+def test_prefix_affinity_probe_hashes_prompt_once(monkeypatch):
+    # Satellite pin: the affinity probe is O(prompt), not
+    # O(replicas x prompt) — the router hashes the prompt into chain
+    # digests ONCE per request and probes every replica's trie with the
+    # digests (pool.match_digests rehashes nothing).
+    from distributeddeeplearning_tpu.serving import scheduler as sched_mod
+
+    model, params = _model_and_params()
+    cfg = ServingConfig(**{**vars(_AFF_CFG), "replicas": 3})
+    router = ReplicaRouter(model, params, cfg)
+    warm = _shared(2, seed=7)
+    router.submit(Request(prompt=list(warm[0]), max_new_tokens=9,
+                          request_id=0))
+    router.run()
+    home = router.routes[0]
+
+    calls = [0]
+    real = sched_mod._block_hash
+
+    def counting(parent, tokens):
+        calls[0] += 1
+        return real(parent, tokens)
+
+    monkeypatch.setattr(sched_mod, "_block_hash", counting)
+    plen = len(warm[1])
+    router.submit(Request(prompt=list(warm[1]), max_new_tokens=9,
+                          request_id=1))
+    assert router.routes[1] == home
+    assert calls[0] == (plen - 1) // cfg.block_size, \
+        "probe rehashed the prompt per replica"

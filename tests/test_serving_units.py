@@ -34,10 +34,11 @@ def _suffix_of(slen):
 
 
 def _sched(slots=2, num_blocks=64, block_size=4, max_seq_len=32,
-           prefix_cache=False):
+           prefix_cache=False, **pool_kw):
     return Scheduler(
         slots,
-        KVBlockPool(num_blocks, block_size, prefix_cache=prefix_cache),
+        KVBlockPool(num_blocks, block_size, prefix_cache=prefix_cache,
+                    **pool_kw),
         max_seq_len,
     )
 
@@ -655,12 +656,51 @@ def test_prefix_stats_and_gauges_shape():
     s = _sched(prefix_cache=True)
     assert "prefix_hit_rate" in s.gauges()
     assert set(s.stats()["prefix_cache"]) == {
-        "hit_tokens", "miss_tokens", "hit_rate", "decode_route_admits",
+        "hit_tokens", "miss_tokens", "hit_tokens_host", "hit_tokens_device",
+        "hit_rate", "decode_route_admits",
         "cached_blocks", "evictable_blocks", "published_total", "evictions",
+        "spill_budget", "spilled_blocks", "spills", "promotes", "adoptions",
+        "final_evictions",
     }
     plain = _sched()
     assert "prefix_hit_rate" not in plain.gauges()
     assert "prefix_cache" not in plain.stats()
+
+
+def test_gauges_expose_cache_occupancy():
+    # Satellite of the memory-hierarchy PR: least-loaded / prefix-affinity
+    # scoring (and the fleet gauge merge) read cache pressure straight
+    # from gauges() — cached (warm device), evictable (reclaimable), and
+    # spilled (host-tier) block counts.
+    s = _sched(slots=2, num_blocks=16, prefix_cache=True)
+    g = s.gauges()
+    assert g["cached_blocks"] == 0
+    assert g["evictable_blocks"] == 0
+    assert g["spilled_blocks"] == 0
+    s.submit(_req(plen=8), now=0.0)
+    (st,) = _padmit(s, 0.0)
+    s.complete(st.slot, now=1.0)  # publishes 2 refcount-0 blocks
+    g = s.gauges()
+    assert g["cached_blocks"] == 2 and g["evictable_blocks"] == 2
+    # A warm re-admission pins the hit: cached stays 2, evictable drops.
+    s.submit(_req(plen=8), now=2.0)
+    _padmit(s, 2.0)
+    g = s.gauges()
+    assert g["cached_blocks"] >= 2 and g["evictable_blocks"] < 2
+    # The cache-off scheduler's gauge record is unchanged in shape.
+    assert "cached_blocks" not in _sched().gauges()
+
+
+def test_gauges_spilled_tier_occupancy_tracks_ledger():
+    s = _sched(slots=1, num_blocks=6, prefix_cache=True, spill_blocks=4)
+    _seed_chain(s.pool, [1, 1, 1, 1])
+    _seed_chain(s.pool, [2, 2, 2, 2])
+    assert s.gauges()["spilled_blocks"] == 0
+    got = s.pool.alloc(5)  # squeeze both refcount-0 nodes out -> host
+    assert s.gauges()["spilled_blocks"] == 2
+    assert s.gauges()["cached_blocks"] == 0
+    s.pool.free(got)
+    assert s.stats()["prefix_cache"]["spilled_blocks"] == 2
 
 
 def test_no_block_leaks_with_prefix_cache_1k():
@@ -721,3 +761,79 @@ def test_no_block_leaks_with_prefix_cache_1k():
     assert len(s.finished) == 1000
     for st in s.finished:
         assert st.blocks == [] and st.published == [] and st.trie_refs == []
+
+
+def test_no_block_leaks_three_tier_1k():
+    # The 1k soak again, over a pool small enough that the shared
+    # prefixes keep getting spilled and promoted: per-step conservation
+    # with the spilled ledger, closed-under-descendants ACROSS tiers,
+    # device-connected-top (a device node's parent is never host), the
+    # spill cap, and spill-store <-> host-ledger agreement. The spill/
+    # drop callbacks mimic the engine's store with a plain dict.
+    import random
+
+    rnd = random.Random(11)
+    store: dict[bytes, int] = {}
+    s = _sched(slots=3, num_blocks=14, block_size=4, max_seq_len=32,
+               prefix_cache=True, spill_blocks=6,
+               spill_fn=lambda pairs: store.update(
+                   {h: b for b, h in pairs}
+               ),
+               drop_fn=store.pop)
+    prefixes = [[p * 100 + i for i in range(8)] for p in range(1, 5)]
+    submitted = finished = 0
+    now = 0.0
+    while finished < 1000:
+        now += 1.0
+        if submitted < 1000 and len(s.pending) < 8:
+            prompt = (list(rnd.choice(prefixes))
+                      + [rnd.randint(1, 50) for _ in range(rnd.randint(1, 6))])
+            s.submit(Request(prompt=prompt,
+                             max_new_tokens=rnd.randint(1, 8)), now=now)
+            submitted += 1
+        for st in _padmit(s, now):
+            # The engine pops promoted payloads from the store on upload.
+            for _, h in st.promoted:
+                store.pop(h)
+            st.promoted = []
+            s.publish_prefix(st, len(st.request.prompt))
+        for st in list(s.active):
+            if rnd.random() < 0.5:
+                st.generated = [rnd.randint(1, 50)
+                                for _ in range(st.request.max_new_tokens)]
+                s.complete(st.slot, now=now)
+                finished += 1
+        # DEVICE conservation is unchanged by the host tier; the spilled
+        # ledger is separate and capped.
+        assert (s.pool.used_blocks + s.pool.free_blocks
+                + s.pool.cached_blocks == 13)
+        assert s.pool.spilled_blocks <= 6
+        # The engine-store mimic and the host ledger agree exactly.
+        assert len(store) == s.pool.spilled_blocks
+        assert set(store) == {
+            nd.chain_hash for b, nd in s.pool._cached.items() if b < 0
+        }
+        for b, nd in s.pool._cached.items():
+            # Closed under descendants, both tiers.
+            if nd.refs == 0:
+                assert all(
+                    s.pool._cached[c].refs == 0 for c in nd.children
+                ), f"refcount-0 node {b} has a live child"
+            # Device-connected-top: host subtrees hang BELOW device
+            # nodes, never above — a host parent of a device node would
+            # break leaf-first device eviction.
+            if b > 0 and nd.parent is not None:
+                assert nd.parent > 0, f"device node {b} under host parent"
+            if b < 0:
+                assert all(c < 0 for c in nd.children), (
+                    f"host node {b} has a device child"
+                )
+                assert nd.refs == 0, f"host node {b} carries refcount"
+    assert s.pool.used_blocks == 0
+    # Flush drops BOTH tiers; drop_fn empties the mimic store.
+    s.pool.flush_cache()
+    assert s.pool.cached_blocks == 0 and s.pool.spilled_blocks == 0
+    assert s.pool.free_blocks == 13 and not store
+    assert s.pool.spills > 0 and s.pool.promotes > 0
+    assert s.pool.final_evictions > 0  # the cap actually bit
+    assert len(s.finished) == 1000

@@ -26,10 +26,12 @@ $DDL_REPORT_OUT).
 
 ``python tools/bench_report.py --check`` validates the COMMITTED
 artifacts this index points at without re-measuring: today that means
-BENCH_SERVING.json's router block (the scale-out + shedding claims) and
+BENCH_SERVING.json's router block (the scale-out + shedding claims),
 prefix_cache block (the shared-prefix KV-reuse reduction, parity, and
-adversarial control), and, when BENCH_TRAJECTORY.json exists, that its
-serving entry actually carries the router and prefix headlines — an
+adversarial control), and kv_hierarchy block (the spill-tier hit-token
+recovery, fp parity, and int8 controls), and, when
+BENCH_TRAJECTORY.json exists, that its serving entry actually carries
+the router, prefix, and kv headlines — an
 index that silently drops a headline it was grown to surface is a
 regression. Exits non-zero listing every failure.
 """
@@ -114,6 +116,20 @@ def _headline(rec: dict) -> dict:
                   "tokens_match_cache_off_shared"):
             if k in px["comparison"]:
                 out["prefix_" + k] = px["comparison"][k]
+    # Serving kv-hierarchy block: the capacity headline — prefix hit
+    # tokens the host spill tier recovers over the bare constrained
+    # device pool, at bitwise fp parity, with the int8 promote probe's
+    # measured drift and the exactly-0.0 adversarial control.
+    kv = rec.get("kv_hierarchy")
+    if isinstance(kv, dict) and isinstance(kv.get("comparison"), dict):
+        for k in ("hit_token_recovery_spill_fp", "tokens_match_spill_off",
+                  "final_evictions_under_tight_budget",
+                  "int8_adversarial_hit_rate"):
+            if k in kv["comparison"]:
+                out["kv_" + k] = kv["comparison"][k]
+        probe = kv["comparison"].get("int8_logit_probe")
+        if isinstance(probe, dict):
+            out["kv_int8_max_rel_drift"] = probe.get("max_rel_drift")
     # FLEET.json (tools/telemetry_report.py fleet rehearsal): the pod-level
     # headline the aggregator exists for.
     fh = rec.get("headline")
@@ -224,6 +240,24 @@ def check() -> int:
           adv_hit is not None and 0.0 <= adv_hit <= 0.01)
     claim("prefix zero_recompiles_with_cache",
           pcomp.get("zero_recompiles_with_cache") is True)
+    # The kv-hierarchy block (host spill tier): the capacity headline,
+    # fp parity under pressure, and the codec's honesty controls.
+    kcomp = serving.get("kv_hierarchy", {}).get("comparison", {})
+    claim("kv_hierarchy block present", bool(kcomp))
+    claim("kv hit_token_recovery_spill_fp >= 2.0",
+          (kcomp.get("hit_token_recovery_spill_fp") or 0) >= 2.0)
+    claim("kv tokens_match_spill_off",
+          kcomp.get("tokens_match_spill_off") is True)
+    claim("kv tokens_match_spill_off_tight",
+          kcomp.get("tokens_match_spill_off_tight") is True)
+    claim("kv final_evictions_under_tight_budget > 0",
+          (kcomp.get("final_evictions_under_tight_budget") or 0) > 0)
+    claim("kv int8_adversarial_hit_rate == 0.0",
+          kcomp.get("int8_adversarial_hit_rate") == 0.0)
+    claim("kv int8_logit_probe ok",
+          (kcomp.get("int8_logit_probe") or {}).get("ok") is True)
+    claim("kv zero_recompiles_with_spill",
+          kcomp.get("zero_recompiles_with_spill") is True)
 
     # The index, when committed, must surface the router headline for the
     # serving artifact (the whole point of indexing it).
@@ -244,6 +278,12 @@ def check() -> int:
         claim("trajectory carries prefix_adversarial_hit_rate",
               head.get("prefix_adversarial_hit_rate")
               == pcomp.get("adversarial_hit_rate"))
+        claim("trajectory carries kv_hit_token_recovery_spill_fp",
+              head.get("kv_hit_token_recovery_spill_fp")
+              == kcomp.get("hit_token_recovery_spill_fp"))
+        claim("trajectory carries kv_int8_adversarial_hit_rate",
+              head.get("kv_int8_adversarial_hit_rate")
+              == kcomp.get("int8_adversarial_hit_rate"))
 
     if failures:
         print(f"bench_report --check: {len(failures)} claim(s) FAILED:")

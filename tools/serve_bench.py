@@ -50,6 +50,24 @@ hit rate on the control, and the widened compile pin
 ``len(prompt_buckets) + len(suffix_buckets) + 1`` with zero
 steady-state recompiles.
 
+The ``kv_hierarchy`` block is the memory-hierarchy story
+(serving.spill_blocks): the shared-prefix workload widened to MORE
+system prompts than the device pool can cache (the pool is rebuilt at
+``_KV_DEVICE_BLOCKS`` via ``engine.constrain_pool`` after warmup), so
+cache-off-duty prefixes are constantly evicted. Four rows on the SAME
+trace and constrained pool: spill off (evicted prefixes go cold),
+spill fp (evicted prefixes demote to host RAM and promote back on the
+next warm admission), spill fp under a deliberately tiny host budget
+(final evictions fire mid-trace), and spill int8 (the quantized codec).
+Pins: spill-on recovers >= 2.0x the prefix hit tokens of spill-off,
+exact token parity for the fp rows (the payload is bitwise) including
+under final-eviction pressure, ``final_evictions > 0`` on the tight
+row, an int8 promote logit probe inside the 5% tolerance, the int8
+adversarial control (random-byte trace, constrained pool) reporting
+``hit_rate == 0.0`` exactly, and the unchanged prefix compile pin with
+zero steady-state recompiles on every row — promotes are eager
+transfers, not programs.
+
 The ``router`` block is the scale-out story (serving/router.py): a
 least-loaded + deadline-shedding ReplicaRouter over replicas in
 ``$DDL_SERVE_REPLICAS`` (default 1,2,4) replaying the trace at offered
@@ -175,6 +193,25 @@ _PX_SERVING_OFF = {k: v for k, v in _PX_SERVING_KW.items()
 _PX_PREFIXES = 4           # distinct system prompts in the trace
 _PX_PREFIX_LEN = 32        # whole blocks (2 x block_size) -> cacheable
 _PX_SUFFIX_LEN = (2, 9)    # per-request tail, fits the 8-wide suffix bucket
+# The KV-hierarchy workload (the kv_hierarchy block): the shared-prefix
+# shape with MORE prefixes than the constrained device pool can hold.
+# 8 prefixes x 2 blocks = 16 blocks of prefix KV against a pool
+# constrained to _KV_DEVICE_BLOCKS (23 usable; 4 lanes x 5 blocks of
+# active demand leaves single-digit cache headroom), so the off-duty
+# prefixes are always under eviction pressure. The default spill budget
+# ($DDL_SERVE_SPILL_BLOCKS) holds the full prefix working set; the
+# tight row's budget holds two prefixes, forcing final evictions.
+_KV_PREFIXES = 8
+_KV_DEVICE_BLOCKS = 24
+_SPILL_BLOCKS = int(os.environ.get("DDL_SERVE_SPILL_BLOCKS", "24"))
+_KV_TIGHT_BLOCKS = 4
+_KV_INT8_TOL = 0.05        # int8 promote logit-drift bar (relative)
+# The kv trace needs enough revisits per prefix for spill->promote round
+# trips to dominate; floor the trace length at 2 visits per prefix so a
+# shrunken smoke _N still exercises the hierarchy end to end.
+_KV_N = int(os.environ.get(
+    "DDL_SERVE_KV_N", str(max(_N, 2 * _KV_PREFIXES))
+))
 # The router scale-out sweep (serving/router.py): offered-load
 # multipliers x replica counts, every request carrying an SLO deadline
 # of arrival + _SLO_S. All three knobs shrink for CI smoke runs.
@@ -259,6 +296,33 @@ def _make_shared_prefix_trace(seed: int):
         max_new = int(rng.integers(*_MAX_NEW))
         trace.append((
             float(arrivals[i]), prefixes[i % _PX_PREFIXES] + suffix,
+            max_new,
+        ))
+    return trace
+
+
+def _make_kv_trace(seed: int):
+    """The shared-prefix trace at _KV_PREFIXES system prompts: request i
+    carries prefix ``i % _KV_PREFIXES``, so by the time a prefix recurs
+    the constrained device pool has evicted it — every warm admission is
+    a spill-tier round trip when the hierarchy is on, and a cold refill
+    when it is off."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / _RATE, _KV_N)
+    arrivals = np.cumsum(gaps)
+    prefixes = [
+        [int(t) for t in rng.integers(1, 256, _PX_PREFIX_LEN)]
+        for _ in range(_KV_PREFIXES)
+    ]
+    trace = []
+    for i in range(_KV_N):
+        slen = int(rng.integers(*_PX_SUFFIX_LEN))
+        suffix = [int(t) for t in rng.integers(1, 256, slen)]
+        max_new = int(rng.integers(*_MAX_NEW))
+        trace.append((
+            float(arrivals[i]), prefixes[i % _KV_PREFIXES] + suffix,
             max_new,
         ))
     return trace
@@ -350,7 +414,8 @@ def _phase_latency_ms(tel):
 
 def _run_mode(model, params, trace, *, static: bool, quant: str = "none",
               kernel: str = "reference", speculation: str = "off",
-              serving_kw: dict | None = None):
+              serving_kw: dict | None = None,
+              constrain_blocks: int | None = None):
     import tempfile
 
     from distributeddeeplearning_tpu.config import ServingConfig
@@ -372,6 +437,11 @@ def _run_mode(model, params, trace, *, static: bool, quant: str = "none",
         telemetry=tel,
     )
     engine.warmup()  # compiles happen HERE, outside the timed window
+    if constrain_blocks is not None:
+        # The kv_hierarchy rows shrink the device pool AFTER warmup (the
+        # compiled programs are pool-size-agnostic — the pool is data),
+        # so eviction pressure is a workload knob, not an hbm budget.
+        engine.constrain_pool(constrain_blocks)
     compiles_before = engine.num_compiles
     # Collect BEFORE the timed loop: the previous rows' dead engines and
     # caches otherwise surface as collector pauses inside THIS row's
@@ -450,6 +520,7 @@ def _run_mode(model, params, trace, *, static: bool, quant: str = "none",
         "queue_s": _hist_pcts(tel.hists.get("queue_wait")),
         "block_high_water": stats["block_high_water"],
         "num_blocks": stats["num_blocks"],
+        "constrained_blocks": constrain_blocks,
         "phase_latency_ms": _phase_latency_ms(tel),
         "decode_donated_args": int(decode_reg.get("donated_args", 0)),
         "compiles_warmup": compiles_before,
@@ -468,6 +539,69 @@ def _run_mode(model, params, trace, *, static: bool, quant: str = "none",
             None if spec is None else spec["mean_accepted_per_step"]
         ),
         "quant_report": stats["quant"],
+    }
+
+
+def _int8_promote_probe(model, params):
+    """The int8 codec bar, measured: seed a prefix, force it to spill,
+    re-admit warm (promote through the codec), and compare the suffix
+    prefill's last-position logits against the fp codec's (fp payloads
+    are bitwise, so the fp run IS the unquantized reference). Mirrors
+    tests/test_serving_spill.py::test_int8_promote_within_logit_tolerance
+    so the committed artifact carries the number the test pins."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from distributeddeeplearning_tpu.config import ServingConfig
+    from distributeddeeplearning_tpu.generate import logits_at, prefill
+    from distributeddeeplearning_tpu.serving import Request, ServingEngine
+
+    def logits(codec):
+        cfg = ServingConfig(**_PX_SERVING_KW, spill_blocks=_SPILL_BLOCKS,
+                            spill_codec=codec)
+        eng = ServingEngine(model, params, cfg, seed=_SEED)
+        eng.warmup()
+        eng.constrain_pool(_KV_DEVICE_BLOCKS)
+        rng = np.random.default_rng(_SEED + 4)
+        prefix = [int(t) for t in rng.integers(1, 256, _PX_PREFIX_LEN)]
+        eng.submit(Request(prompt=prefix + [50, 51], max_new_tokens=2))
+        eng.run()
+        pool = eng.scheduler.pool
+        got = pool.alloc(pool.free_blocks + pool.evictable_blocks)
+        pool.free(got)
+        assert pool.spilled_blocks >= 2, "prefix never spilled"
+        eng.submit(Request(prompt=prefix + [60, 61], max_new_tokens=2))
+        (st,) = eng.scheduler.admit(
+            0.0, eng.bucket_of, suffix_bucket_of=eng.suffix_bucket_of,
+            cover_tokens=eng.pages * eng.block_size,
+        )
+        assert st.promoted, "warm admission did not cross the host tier"
+        eng._apply_promotions(st)
+        row = np.zeros((eng.pages,), np.int32)
+        chain = st.cached_blocks + st.blocks
+        row[:len(chain)] = chain
+        suffix = st.request.prompt[st.cached_len:]
+        tokens = np.zeros((1, st.bucket), np.int32)
+        tokens[0, :len(suffix)] = suffix
+        cache1 = eng._inject(eng._cache, row[None],
+                             np.int32([st.cached_len]))
+        out, _ = prefill(eng.model, eng._dequant(eng._params), cache1,
+                         jnp.asarray(tokens))
+        return np.asarray(
+            logits_at(out, jnp.asarray(np.int32([len(suffix) - 1]))),
+            np.float32,
+        )
+
+    ref, quant = logits("fp"), logits("int8")
+    scale = float(np.abs(ref).max())
+    drift = float(np.abs(ref - quant).max())
+    rel = drift / scale if scale else 0.0
+    return {
+        "max_abs_logit_drift": round(drift, 6),
+        "fp_logit_scale": round(scale, 6),
+        "max_rel_drift": round(rel, 6),
+        "tolerance": _KV_INT8_TOL,
+        "ok": bool(rel <= _KV_INT8_TOL),
     }
 
 
@@ -807,6 +941,83 @@ def main() -> int:
             ),
         },
     }
+    # The kv_hierarchy block: the shared-prefix workload at 8 prefixes on
+    # a device pool constrained too small to cache them, spill off / fp /
+    # fp-tight / int8, plus the int8 adversarial control (the random-byte
+    # trace, same constrained pool) and the measured int8 logit probe.
+    kv_trace = _make_kv_trace(_SEED + 3)
+    kv_kw_fp = {**_PX_SERVING_KW, "spill_blocks": _SPILL_BLOCKS}
+    kv_kw_tight = {**_PX_SERVING_KW, "spill_blocks": _KV_TIGHT_BLOCKS}
+    kv_kw_int8 = {**kv_kw_fp, "spill_codec": "int8"}
+    kv_off = _run_mode(model, params, kv_trace, static=False,
+                       serving_kw=_PX_SERVING_KW,
+                       constrain_blocks=_KV_DEVICE_BLOCKS)
+    kv_fp = _run_mode(model, params, kv_trace, static=False,
+                      serving_kw=kv_kw_fp,
+                      constrain_blocks=_KV_DEVICE_BLOCKS)
+    kv_tight = _run_mode(model, params, kv_trace, static=False,
+                         serving_kw=kv_kw_tight,
+                         constrain_blocks=_KV_DEVICE_BLOCKS)
+    kv_int8 = _run_mode(model, params, kv_trace, static=False,
+                        serving_kw=kv_kw_int8,
+                        constrain_blocks=_KV_DEVICE_BLOCKS)
+    kv_adv = _run_mode(model, params, trace, static=False,
+                       serving_kw=kv_kw_int8,
+                       constrain_blocks=_KV_DEVICE_BLOCKS)
+    kv_probe = _int8_promote_probe(model, params)
+    kv_rows = [kv_off, kv_fp, kv_tight, kv_int8, kv_adv]
+    kv_block = {
+        "workload": {
+            "prefixes": _KV_PREFIXES,
+            "prefix_len": _PX_PREFIX_LEN,
+            "suffix_len_range": list(_PX_SUFFIX_LEN),
+            "max_new_range": list(_MAX_NEW),
+            "requests": _KV_N, "rate_req_per_s": _RATE,
+            "seed": _SEED + 3,
+        },
+        "device_blocks": _KV_DEVICE_BLOCKS,
+        "spill_blocks": _SPILL_BLOCKS,
+        "tight_spill_blocks": _KV_TIGHT_BLOCKS,
+        "rows": kv_rows,
+        "comparison": {
+            # THE memory-hierarchy headline (acceptance bar >= 2.0):
+            # prefix hit tokens the spill tier recovers over what the
+            # same constrained device pool retains on its own.
+            "hit_token_recovery_spill_fp": round(
+                kv_fp["prefix"]["hit_tokens"]
+                / max(kv_off["prefix"]["hit_tokens"], 1), 3
+            ),
+            "hit_tokens_spill_off": kv_off["prefix"]["hit_tokens"],
+            "hit_tokens_spill_fp": kv_fp["prefix"]["hit_tokens"],
+            "hit_tokens_host_spill_fp":
+                kv_fp["prefix"]["hit_tokens_host"],
+            "promotes_spill_fp": kv_fp["prefix"]["promotes"],
+            "spills_spill_fp": kv_fp["prefix"]["spills"],
+            # fp payloads are bitwise: the hierarchy changes WHERE KV
+            # waits, never the tokens — including when the tight budget
+            # final-evicts mid-trace and prefixes drop back to cold.
+            "tokens_match_spill_off":
+                kv_fp["token_checksum"] == kv_off["token_checksum"],
+            "tokens_match_spill_off_tight":
+                kv_tight["token_checksum"] == kv_off["token_checksum"],
+            "final_evictions_under_tight_budget":
+                kv_tight["prefix"]["final_evictions"],
+            "int8_promotes": kv_int8["prefix"]["promotes"],
+            "int8_hit_tokens": kv_int8["prefix"]["hit_tokens"],
+            # Honest control: unique random prompts, constrained pool,
+            # int8 codec armed — nothing ever matches, so nothing is
+            # promoted and no request's logits touch quantized KV.
+            "int8_adversarial_hit_rate": kv_adv["prefix"]["hit_rate"],
+            "int8_logit_probe": kv_probe,
+            # Spill/promote are eager host transfers, not programs: the
+            # prefix compile pin is unchanged on every row.
+            "compile_pin": px_pin,
+            "zero_recompiles_with_spill": all(
+                r["compiles_after_run"] == r["compiles_warmup"] == px_pin
+                for r in kv_rows
+            ),
+        },
+    }
     record = {
         "benchmark": "serving",
         "workload": {
@@ -820,6 +1031,7 @@ def main() -> int:
         "rows": rows,
         "router": router_block,
         "prefix_cache": prefix_block,
+        "kv_hierarchy": kv_block,
         "speculation": {
             "k": _SPEC_K,
             "workload": {
@@ -890,6 +1102,7 @@ def main() -> int:
     print(json.dumps(record["speculation"]["comparison"], indent=2))
     print(json.dumps(record["router"]["comparison"], indent=2))
     print(json.dumps(record["prefix_cache"]["comparison"], indent=2))
+    print(json.dumps(record["kv_hierarchy"]["comparison"], indent=2))
     print(f"wrote {_OUT}")
     return 0
 
@@ -971,6 +1184,28 @@ def check(path: str = _OUT) -> int:
           shared_hit is not None and 0.0 < shared_hit < 1.0)
     claim("prefix_zero_recompiles_with_cache",
           pcomp.get("zero_recompiles_with_cache") is True)
+    # KV-hierarchy claims: >= 2x prefix hit-token recovery under the
+    # constrained device pool, bitwise fp parity (incl. under the tight
+    # host budget, which must actually final-evict), the int8 promote
+    # logit probe inside tolerance, an exactly-0.0 int8 adversarial hit
+    # rate, and the unchanged compile pin across every spill row.
+    kcomp = record.get("kv_hierarchy", {}).get("comparison", {})
+    claim("kv_hit_token_recovery_spill_fp >= 2.0",
+          (kcomp.get("hit_token_recovery_spill_fp") or 0) >= 2.0)
+    claim("kv_tokens_match_spill_off",
+          kcomp.get("tokens_match_spill_off") is True)
+    claim("kv_tokens_match_spill_off_tight",
+          kcomp.get("tokens_match_spill_off_tight") is True)
+    claim("kv_final_evictions_under_tight_budget > 0",
+          (kcomp.get("final_evictions_under_tight_budget") or 0) > 0)
+    claim("kv_promotes_spill_fp > 0",
+          (kcomp.get("promotes_spill_fp") or 0) > 0)
+    claim("kv_int8_adversarial_hit_rate == 0.0",
+          kcomp.get("int8_adversarial_hit_rate") == 0.0)
+    claim("kv_int8_logit_probe_ok",
+          (kcomp.get("int8_logit_probe") or {}).get("ok") is True)
+    claim("kv_zero_recompiles_with_spill",
+          kcomp.get("zero_recompiles_with_spill") is True)
 
     if failures:
         print(f"{path}: {len(failures)} claim(s) FAILED:")
