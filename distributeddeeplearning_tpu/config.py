@@ -399,6 +399,19 @@ class ServingConfig:
     # logits drift within the pinned tolerance — see BENCH_SERVING.json
     # kv_hierarchy). Only meaningful with spill_blocks > 0 — fenced.
     spill_codec: str = "fp"
+    # Quantized DEVICE-resident paged KV (docs/SERVING.md quantized-KV
+    # section): 'off' stores pool blocks in the model dtype; 'int8'
+    # stores them as int8 with one f32 scale per (page slot, kv head)
+    # D-vector in a parallel scale pool — quantized once at scatter
+    # (write) time, dequantized inline on the read path (fused into the
+    # Pallas per-page DMA; dequant-on-gather in the reference kernel),
+    # so the same HBM budget mints ~2-4x more pool blocks (the engine's
+    # sizing probe measures the real per-block bytes). fp32 attention
+    # carries are unchanged; greedy output drifts within the pinned
+    # tolerance (BENCH_SERVING.json kv_quant). Incompatible by name
+    # with spill_codec='int8' (spilled payloads are ALREADY int8 —
+    # double quantization would compound error for zero bytes saved).
+    kv_quant: str = "off"
     # Engine replication (serving/router.py; docs/SERVING.md router
     # section): number of identical ServingEngine replicas behind a
     # ReplicaRouter — in-process on CPU sim, one mesh/device group per

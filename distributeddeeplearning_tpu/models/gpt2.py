@@ -47,6 +47,9 @@ class GPT2(nn.Module):
     # Paged read path: 'reference' (gather) or 'pallas' (fused in-place
     # kernel, ops/paged_attention.py) — serving.attn_kernel.
     paged_kernel: str = "reference"
+    # Paged pool storage: 'off' or 'int8' (quantize at scatter, dequant
+    # on read) — serving.kv_quant (transformer.paged_decode_attention).
+    kv_quant: str = "off"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -133,6 +136,7 @@ class GPT2(nn.Module):
             decode=self.decode,
             kv_pages=self.kv_pages,
             paged_kernel=self.paged_kernel,
+            kv_quant=self.kv_quant,
             name="h",
         )(x, None, not train)
         x = layer_norm(1e-5, self.dtype, "ln_f")(x)

@@ -111,6 +111,9 @@ class LlamaAttention(nn.Module):
     # Paged read path: 'reference' (gather) or 'pallas' (fused in-place
     # kernel, ops/paged_attention.py) — serving.attn_kernel.
     paged_kernel: str = "reference"
+    # Paged pool storage: 'off' or 'int8' (quantize at scatter, dequant
+    # on read) — serving.kv_quant (transformer.paged_decode_attention).
+    kv_quant: str = "off"
 
     @nn.compact
     def __call__(self, x):
@@ -189,6 +192,7 @@ class LlamaAttention(nn.Module):
             out = paged_decode_attention(
                 self, q, k, v, dtype=self.dtype, kv_pages=self.kv_pages,
                 num_rep=rep, lens_var=lens_var, kernel=self.paged_kernel,
+                kv_quant=self.kv_quant,
             )
         elif self.decode:
             out = decode_attention(
@@ -300,6 +304,7 @@ class LlamaBlock(nn.Module):
     decode: bool = False  # KV-cache decoding
     kv_pages: tuple | None = None  # paged serving cache (LlamaAttention)
     paged_kernel: str = "reference"  # paged read path (LlamaAttention)
+    kv_quant: str = "off"  # paged pool storage codec (LlamaAttention)
 
     @nn.compact
     def __call__(self, x):
@@ -309,7 +314,8 @@ class LlamaBlock(nn.Module):
             attn_impl=self.attn_impl, mesh=self.mesh,
             psum_axis=self.psum_axis, manual_tp_ad=self.manual_tp_ad,
             decode=self.decode, kv_pages=self.kv_pages,
-            paged_kernel=self.paged_kernel, name="attn",
+            paged_kernel=self.paged_kernel, kv_quant=self.kv_quant,
+            name="attn",
         )(RMSNorm(self.rms_eps, self.dtype, name="attn_norm")(x))
         if self.constrain_out:
             x = constrain(x, "batch", "seq", "embed")
@@ -344,6 +350,8 @@ class Llama(nn.Module):
     # Paged read path: 'reference' (gather) or 'pallas' (fused in-place
     # kernel, ops/paged_attention.py) — serving.attn_kernel.
     paged_kernel: str = "reference"
+    # Paged pool storage: 'off' or 'int8' — serving.kv_quant.
+    kv_quant: str = "off"
     # True: the LM head shares the embedding table (Llama-3.2-class small
     # checkpoints; HF tie_word_embeddings) — no separate lm_head param.
     tie_embeddings: bool = False
@@ -374,7 +382,7 @@ class Llama(nn.Module):
                 rope_theta=self.rope_theta, rms_eps=self.rms_eps,
                 dtype=self.dtype, attn_impl=self.attn_impl, mesh=self.mesh,
                 decode=self.decode, kv_pages=self.kv_pages,
-                paged_kernel=self.paged_kernel,
+                paged_kernel=self.paged_kernel, kv_quant=self.kv_quant,
                 name=f"block_{i}",
             )(x)
         x = RMSNorm(self.rms_eps, self.dtype, name="norm")(x)

@@ -23,6 +23,7 @@ import numpy as np
 from flax import linen as nn
 
 from ..comms import identity_fwd_psum_bwd, psum_identity_bwd
+from ..comms_quant import block_quantize
 from ..sharding import constrain
 
 Dtype = jnp.dtype
@@ -128,7 +129,8 @@ def _cache_attend(q, ck, cv, visible, num_rep: int, dtype):
 
 def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
                            num_rep: int = 1, lens_var=None,
-                           kernel: str = "reference"):
+                           kernel: str = "reference",
+                           kv_quant: str = "off"):
     """Decode/prefill attention against a PAGED KV cache (serving engine).
 
     Instead of one contiguous [B, max_len] cache per sequence, k/v live in a
@@ -185,19 +187,51 @@ def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
 
     The pool WRITE (scatter at the cursor) is the same XLA
     scatter-at-indices in both modes; only the read side differs.
+
+    ``kv_quant='int8'`` (``serving.kv_quant``) stores the pool as int8
+    with parallel f32 scale pools ``pool_key_scale``/``pool_value_scale``
+    of shape [num_blocks, block_size, kv_heads]: ONE scale per written
+    (token slot, kv head) D-vector, computed by ``comms_quant.
+    block_quantize`` at scatter time with block_size=D — so each slot is
+    quantized exactly once when its KV is written and never touched
+    again (published-block immutability holds bitwise; a per-PAGE scale
+    would have to re-quantize already-written slots as the page's absmax
+    grew under progressive decode). The read path dequantizes: the
+    reference kernel on the gathered pages (dequant-on-gather), the
+    Pallas kernel inline in VMEM per page DMA (``ops/paged_attention``).
+    Scale overhead is 4/D bytes per int8 KV byte (~6%% at D=64), so one
+    fp32 pool block's budget holds ~3.8x more int8 tokens — the engine's
+    sizing probe measures the real ratio.
     """
     if kernel not in ("reference", "pallas"):
         raise ValueError(
             f"paged kernel must be 'reference' or 'pallas', got {kernel!r}"
         )
+    if kv_quant not in ("off", "int8"):
+        raise ValueError(
+            f"kv_quant must be 'off' or 'int8', got {kv_quant!r}"
+        )
+    quantized = kv_quant == "int8"
     num_blocks, bs, pages = kv_pages
     B, L, Hkv, D = k.shape
     pk = module.variable(
-        "cache", "pool_key", jnp.zeros, (num_blocks, bs, Hkv, D), k.dtype
+        "cache", "pool_key", jnp.zeros, (num_blocks, bs, Hkv, D),
+        jnp.int8 if quantized else k.dtype,
     )
     pv = module.variable(
-        "cache", "pool_value", jnp.zeros, (num_blocks, bs, Hkv, D), v.dtype
+        "cache", "pool_value", jnp.zeros, (num_blocks, bs, Hkv, D),
+        jnp.int8 if quantized else v.dtype,
     )
+    sk = sv = None
+    if quantized:
+        sk = module.variable(
+            "cache", "pool_key_scale", jnp.zeros,
+            (num_blocks, bs, Hkv), jnp.float32,
+        )
+        sv = module.variable(
+            "cache", "pool_value_scale", jnp.zeros,
+            (num_blocks, bs, Hkv), jnp.float32,
+        )
     table = module.variable(
         "cache", "page_table", lambda: jnp.zeros((B, pages), jnp.int32)
     )
@@ -215,11 +249,31 @@ def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
     pos = lens.value[:, None] + jnp.arange(L)[None, :]  # [B, L] absolute
     blk = jnp.take_along_axis(table.value, pos // bs, axis=1)
     flat = (blk * bs + pos % bs).reshape(-1)
+    k_w, v_w = k, v
+    if quantized:
+        # Quantize-at-write: one comms_quant block per (token, head)
+        # D-vector (block_size=D), so the scale for a slot is final the
+        # moment its KV lands and scatters to the SAME flat index as the
+        # int8 values.
+        qk, k_scale = block_quantize(
+            k.astype(jnp.float32).reshape(-1), D
+        )
+        qv, v_scale = block_quantize(
+            v.astype(jnp.float32).reshape(-1), D
+        )
+        k_w = qk.reshape(B * L, Hkv, D)
+        v_w = qv.reshape(B * L, Hkv, D)
+        sk.value = sk.value.reshape(num_blocks * bs, Hkv).at[flat].set(
+            k_scale.reshape(B * L, Hkv)
+        ).reshape(sk.value.shape)
+        sv.value = sv.value.reshape(num_blocks * bs, Hkv).at[flat].set(
+            v_scale.reshape(B * L, Hkv)
+        ).reshape(sv.value.shape)
     pk.value = pk.value.reshape(num_blocks * bs, Hkv, D).at[flat].set(
-        k.reshape(B * L, Hkv, D)
+        k_w.reshape(B * L, Hkv, D)
     ).reshape(pk.value.shape)
     pv.value = pv.value.reshape(num_blocks * bs, Hkv, D).at[flat].set(
-        v.reshape(B * L, Hkv, D)
+        v_w.reshape(B * L, Hkv, D)
     ).reshape(pv.value.shape)
     if kernel == "pallas" and L == 1:
         from ..ops.paged_attention import paged_attention
@@ -227,11 +281,23 @@ def paged_decode_attention(module, q, k, v, *, dtype, kv_pages,
         out = paged_attention(
             q[:, 0], pk.value, pv.value, table.value, lens.value,
             num_rep=num_rep,
+            scale_k=sk.value if quantized else None,
+            scale_v=sv.value if quantized else None,
         )[:, None]
     else:
         # Gather each row's pages into logical order: [B, pages*bs, Hkv, D].
         ck = pk.value[table.value].reshape(B, pages * bs, Hkv, D)
         cv = pv.value[table.value].reshape(B, pages * bs, Hkv, D)
+        if quantized:
+            # Dequant-on-gather: the gathered int8 pages scale back to f32
+            # against their gathered scale rows — the reference lowering's
+            # mirror of the Pallas kernel's in-VMEM dequant.
+            ck = ck.astype(jnp.float32) * sk.value[table.value].reshape(
+                B, pages * bs, Hkv
+            )[..., None]
+            cv = cv.astype(jnp.float32) * sv.value[table.value].reshape(
+                B, pages * bs, Hkv
+            )[..., None]
         cols = jnp.arange(pages * bs)
         visible = cols[None, None, :] <= pos[:, :, None]  # causal per row
         out = _cache_attend(q, ck, cv, visible, num_rep, dtype)
@@ -375,6 +441,10 @@ class SelfAttention(nn.Module):
     # Paged read path: 'reference' (gather) or 'pallas' (in-place fused
     # kernel, ops/paged_attention.py) — serving.attn_kernel.
     paged_kernel: str = "reference"
+    # Paged pool storage: 'off' (model dtype) or 'int8' (quantize at
+    # scatter, dequant on read; scale pools ride in the cache) —
+    # serving.kv_quant (paged_decode_attention).
+    kv_quant: str = "off"
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -413,7 +483,7 @@ class SelfAttention(nn.Module):
                     )
                 out = paged_decode_attention(
                     self, q, k, v, dtype=self.dtype, kv_pages=self.kv_pages,
-                    kernel=self.paged_kernel,
+                    kernel=self.paged_kernel, kv_quant=self.kv_quant,
                 )
             else:
                 out = decode_attention(self, q, k, v, dtype=self.dtype,
@@ -580,6 +650,7 @@ class TransformerBlock(nn.Module):
     decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
     kv_pages: tuple | None = None  # paged serving cache (SelfAttention)
     paged_kernel: str = "reference"  # paged read path (SelfAttention)
+    kv_quant: str = "off"  # paged pool storage codec (SelfAttention)
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -597,6 +668,7 @@ class TransformerBlock(nn.Module):
             decode=self.decode,
             kv_pages=self.kv_pages,
             paged_kernel=self.paged_kernel,
+            kv_quant=self.kv_quant,
             name="attn",
         )
         mlp = Mlp(
@@ -644,6 +716,7 @@ class TransformerStack(nn.Module):
     decode: bool = False  # KV-cache decoding (see SelfAttention.decode)
     kv_pages: tuple | None = None  # paged serving cache (SelfAttention)
     paged_kernel: str = "reference"  # paged read path (SelfAttention)
+    kv_quant: str = "off"  # paged pool storage codec (SelfAttention)
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -673,6 +746,7 @@ class TransformerStack(nn.Module):
                 decode=self.decode,
                 kv_pages=self.kv_pages,
                 paged_kernel=self.paged_kernel,
+                kv_quant=self.kv_quant,
                 name=f"block_{i}",
             )(x, mask, deterministic)
         return x

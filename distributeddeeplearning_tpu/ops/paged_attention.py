@@ -36,6 +36,18 @@ scattered this step's k/v into the pool at position ``seq_lens[b]``, and
 row b attends columns ``0 .. seq_lens[b]`` inclusive. Idle rows (cursor
 0, page table parked on the null block) attend exactly position 0 of the
 null block — same as the reference; the engine discards their output.
+
+Quantized pools (``serving.kv_quant='int8'``): the pool arrives as int8
+with one f32 scale per (page slot, kv head) D-vector in parallel scale
+pools ``[num_blocks, block_size, kv_heads]`` (written at scatter time by
+``transformer.paged_decode_attention``). The quantized kernel variant
+adds two BlockSpec operands whose index_maps follow the SAME
+``page_table[b, j]`` indirection — the per-page DMA pulls the int8 page
+AND its scale rows into VMEM together, and the dequant
+(``values.astype(f32) * scale``, the ``comms_quant`` codec inverse) is
+fused inline before the online-softmax dot. The fp32 carries (m, l, acc)
+are unchanged, so the only numerics delta vs the fp kernel is the
+quantization grid itself.
 """
 
 from __future__ import annotations
@@ -103,8 +115,94 @@ def _decode_kernel(
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
+def _decode_kernel_q8(
+    table_ref, lens_ref, q_ref, k_ref, v_ref, sk_ref, sv_ref, o_ref,
+    m_scr, l_scr, acc_scr, *, sm_scale, block_size, num_pages,
+):
+    """Quantized-pool variant of ``_decode_kernel``: identical online-
+    softmax carry, but the page's int8 k/v are dequantized in VMEM
+    (``q.astype(f32) * scale``) right after the DMA, before the dots.
+    ``sk_ref``/``sv_ref`` are the page's scale rows, one f32 per
+    (slot, group) D-vector, fetched by the same ``tbl[b, j]`` index_map
+    as the page itself."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = lens_ref[b]
+
+    @pl.when(j * block_size <= pos)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (num_rep, D)
+        # Inline dequant: block shapes are (1, block_size, 1, D) for the
+        # int8 page and (1, block_size, 1) for its scale row; sk_ref[0]
+        # is already 2D (block_size, 1) and broadcasts over D.
+        k = k_ref[0, :, 0].astype(jnp.float32) * sk_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (num_rep, block_size)
+        col = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(col <= pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, :, 0].astype(jnp.float32) * sv_ref[0]
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _check_scales(pool_k, scale_k, scale_v):
+    """Validate the quantized-pool operand set: int8 pools require BOTH
+    scale pools with the pool's (num_blocks, block_size, kv_heads)
+    layout; fp pools must not carry scales (a silently ignored scale
+    buffer is a caller bug). Returns True when the pool is quantized."""
+    num_blocks, block_size, kv_heads, _ = pool_k.shape
+    quantized = pool_k.dtype == jnp.int8
+    if not quantized:
+        if scale_k is not None or scale_v is not None:
+            raise ValueError(
+                f"scale_k/scale_v passed with a non-int8 pool "
+                f"(dtype {pool_k.dtype}) — scales only pair with "
+                "kv_quant='int8' pools"
+            )
+        return False
+    want = (num_blocks, block_size, kv_heads)
+    for name, s in (("scale_k", scale_k), ("scale_v", scale_v)):
+        if s is None:
+            raise ValueError(
+                f"int8 pool without {name}: quantized pools need one f32 "
+                f"scale per (page slot, kv head) — shape {want}"
+            )
+        if tuple(s.shape) != want:
+            raise ValueError(
+                f"{name} shape {tuple(s.shape)} must be "
+                f"[num_blocks, block_size, kv_heads] = {want}"
+            )
+    return True
+
+
 def paged_attention(
     q, pool_k, pool_v, page_table, seq_lens, *,
+    scale_k=None, scale_v=None,
     num_rep: int = 1,
     sm_scale: float | None = None,
     interpret: bool | None = None,
@@ -122,7 +220,11 @@ def paged_attention(
       ``transformer.paged_decode_attention``);
     - ``seq_lens``: [B] int32 — the row's cursor BEFORE this token
       advances it: row b attends columns ``0 .. seq_lens[b]`` of its
-      logical sequence (its own just-written k/v included).
+      logical sequence (its own just-written k/v included);
+    - ``scale_k`` / ``scale_v``: [num_blocks, block_size, kv_heads] f32,
+      REQUIRED iff the pool is int8 (``serving.kv_quant='int8'``) — the
+      per-(slot, head) dequant scales, DMA'd per page beside the int8
+      block and applied inline before the dots.
 
     Returns [B, H, D] in q's dtype. ``interpret=None`` auto-selects
     interpret mode off-TPU (the CPU test harness).
@@ -148,30 +250,39 @@ def paged_attention(
         sm_scale = float(1.0 / np.sqrt(D))
     if interpret is None:
         interpret = _default_interpret()
+    quantized = _check_scales(pool_k, scale_k, scale_v)
 
     # Group-major head fold: head g*num_rep+r -> (group g, rep r).
     q4 = q.reshape(B, kv_heads, num_rep, D)
     kernel = functools.partial(
-        _decode_kernel,
+        _decode_kernel_q8 if quantized else _decode_kernel,
         sm_scale=sm_scale, block_size=block_size, num_pages=num_pages,
     )
+    # The paged reads: physical block (and, quantized, its scale rows)
+    # straight off the scalar-prefetched table.
+    page_spec = pl.BlockSpec(
+        (1, block_size, 1, D),
+        lambda b, g, j, tbl, lens: (tbl[b, j], 0, g, 0),
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, num_rep, D), lambda b, g, j, tbl, lens: (b, g, 0, 0)
+        ),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q4, pool_k, pool_v]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, block_size, 1),
+            lambda b, g, j, tbl, lens: (tbl[b, j], 0, g),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [scale_k, scale_v]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, kv_heads, num_pages),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, num_rep, D), lambda b, g, j, tbl, lens: (b, g, 0, 0)
-            ),
-            # The paged read: physical block straight off the table.
-            pl.BlockSpec(
-                (1, block_size, 1, D),
-                lambda b, g, j, tbl, lens: (tbl[b, j], 0, g, 0),
-            ),
-            pl.BlockSpec(
-                (1, block_size, 1, D),
-                lambda b, g, j, tbl, lens: (tbl[b, j], 0, g, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, num_rep, D), lambda b, g, j, tbl, lens: (b, g, 0, 0)
         ),
@@ -188,22 +299,29 @@ def paged_attention(
         interpret=interpret,
     )(
         jnp.asarray(page_table, jnp.int32), jnp.asarray(seq_lens, jnp.int32),
-        q4, pool_k, pool_v,
+        *operands,
     )
     return out.reshape(B, H, D)
 
 
 def paged_attention_reference(q, pool_k, pool_v, page_table, seq_lens, *,
-                              num_rep: int = 1):
+                              scale_k=None, scale_v=None, num_rep: int = 1):
     """Pure-jnp oracle: the engine's gather lowering, kernel-level shapes.
 
     Same math as ``transformer.paged_decode_attention``'s reference path
     (gather pages -> mask ``col <= cursor`` -> fp32 softmax), restated on
-    the kernel's [B, H, D] single-token signature for parity tests.
+    the kernel's [B, H, D] single-token signature for parity tests. With
+    an int8 pool the gathered pages dequantize against the gathered scale
+    rows — the same dequant-on-gather lowering the engine ships.
     """
     B, H, D = q.shape
     nb, bs, kv_heads, _ = pool_k.shape
     pages = page_table.shape[-1]
+    quantized = _check_scales(pool_k, scale_k, scale_v)
+    pool_k, pool_v = pool_k.astype(jnp.float32), pool_v.astype(jnp.float32)
+    if quantized:
+        pool_k = pool_k * scale_k[..., None]
+        pool_v = pool_v * scale_v[..., None]
     ck = pool_k[page_table].reshape(B, pages * bs, kv_heads, D)
     cv = pool_v[page_table].reshape(B, pages * bs, kv_heads, D)
     qg = q.reshape(B, kv_heads, num_rep, D)

@@ -109,8 +109,19 @@ from .scheduler import (
     KVBlockPool, Request, RequestState, Scheduler, blocks_for, ngram_draft,
 )
 
-_POOL_LEAVES = ("pool_key", "pool_value")
+# Pool leaves shared between the B=1 prefill and B=slots decode programs
+# (fold/spill/promote/sizing all match by NAME): the KV blocks themselves
+# plus, with kv_quant='int8', the parallel per-(slot, head) scale pools
+# (transformer.paged_decode_attention creates them; with kv_quant='off'
+# the scale names simply never appear in the cache pytree, so every
+# name-matching path degrades to the fp pair for free).
+_POOL_LEAVES = (
+    "pool_key", "pool_value", "pool_key_scale", "pool_value_scale",
+)
 _HOST_LEAVES = ("page_table", "seq_lens")
+
+# serving.kv_quant domain: device pool storage codecs.
+KV_QUANT_MODES = ("off", "int8")
 
 # int8 spill codec quantization granularity (elements per scale), matching
 # comms_quant's gradient path: per-256-block absmax keeps the dequant
@@ -255,6 +266,28 @@ def _check_spill(spill_blocks, spill_codec, prefix_cache) -> int:
     return sb
 
 
+def _check_kv_quant(kv_quant, spill_codec) -> str:
+    """The quantized-device-KV composition fences (by name, config time),
+    shared by ``check_serving_composition`` and ``ServingEngine``.
+    Returns the validated mode."""
+    mode = str(kv_quant or "off")
+    if mode not in KV_QUANT_MODES:
+        raise ValueError(
+            f"serving.kv_quant must be one of {KV_QUANT_MODES}, got "
+            f"{kv_quant!r}"
+        )
+    if mode == "int8" and str(spill_codec or "fp") == "int8":
+        raise ValueError(
+            "serving.kv_quant='int8' x spill_codec='int8': the device "
+            "pool is ALREADY int8, so spilled payloads are int8+scales "
+            "bitwise — re-quantizing them through the spill codec would "
+            "compound quantization error for zero bytes saved (redundant "
+            "double quantization). Keep spill_codec='fp' (bitwise "
+            "pass-through of the int8 payload) or kv_quant='off'."
+        )
+    return mode
+
+
 def check_serving_composition(cfg) -> None:
     """Config-time composition fences for ``serve`` (PR-5 style: fail BY
     NAME before any compile). ``cfg`` is the full Config."""
@@ -346,6 +379,9 @@ def check_serving_composition(cfg) -> None:
     _check_spill(
         getattr(s, "spill_blocks", 0), getattr(s, "spill_codec", "fp"),
         prefix_on,
+    )
+    _check_kv_quant(
+        getattr(s, "kv_quant", "off"), getattr(s, "spill_codec", "fp")
     )
     if policy == "prefix_affinity" and not prefix_on:
         raise ValueError(
@@ -440,6 +476,24 @@ class ServingEngine:
             getattr(cfg, "spill_codec", "fp"), self.prefix_cache,
         )
         self.spill_codec = str(getattr(cfg, "spill_codec", "fp") or "fp")
+        # Quantized device-resident paged KV (module docstring): int8
+        # blocks + parallel scale pools, quantized at scatter time,
+        # dequantized on the read path. Fenced here as well as at config
+        # time; the spill tier carries int8 payloads through the 'fp'
+        # (bitwise) codec path — spill_codec='int8' on top is rejected
+        # by name as redundant double quantization.
+        self.kv_quant = _check_kv_quant(
+            getattr(cfg, "kv_quant", "off"), self.spill_codec
+        )
+        if static_batching and self.kv_quant != "off":
+            raise NotImplementedError(
+                f"serving.kv_quant={self.kv_quant!r} x static_batching: "
+                "the static baseline exists as the exact-numerics anchor "
+                "the bench comparisons (and parity claims) are measured "
+                "against, and a quantized pool perturbs logits — "
+                "benchmark kv_quant against the kv_quant='off' "
+                "CONTINUOUS engine instead (tools/serve_bench.py does)"
+            )
         if static_batching and self.spill_blocks:
             raise NotImplementedError(
                 "serving.spill_blocks x static_batching (spill_codec="
@@ -479,7 +533,14 @@ class ServingEngine:
         # --- size the pool from the HBM budget --------------------------
         # Bytes per block from a shape-only init probe with num_blocks=1:
         # whatever the model actually allocates per layer, no hand model.
-        probe = model.clone(decode=True, kv_pages=(1, bs, self.pages))
+        # With kv_quant='int8' the probe sees the int8 pools PLUS their
+        # f32 scale pools, so block_bytes shrinks ~3.8x (int8 values +
+        # 4/D scale overhead) and the SAME budget mints proportionally
+        # more blocks — the capacity win, measured rather than assumed.
+        probe = model.clone(
+            decode=True, kv_pages=(1, bs, self.pages),
+            kv_quant=self.kv_quant,
+        )
         tok1 = jax.ShapeDtypeStruct((S, 1), jnp.int32)
         shapes = jax.eval_shape(probe.init, jax.random.PRNGKey(0), tok1)
         block_bytes = sum(
@@ -513,7 +574,7 @@ class ServingEngine:
             )
         self.model = model.clone(
             decode=True, kv_pages=self.kv_pages,
-            paged_kernel=self.attn_kernel,
+            paged_kernel=self.attn_kernel, kv_quant=self.kv_quant,
         )
         # Prefill/decode priority: cap admissions (each costs one prefill)
         # per engine step so a queue burst cannot stall the running decode
@@ -565,6 +626,8 @@ class ServingEngine:
                         spill_fn=self._spill_out,
                         drop_fn=self._spill_drop),
             self.max_seq_len,
+            kv_bytes_per_token=self.block_bytes // bs,
+            kv_quant=self.kv_quant,
         )
         self._table = np.zeros((S, self.pages), np.int32)
         self._lens = np.zeros((S,), np.int32)
@@ -782,6 +845,34 @@ class ServingEngine:
             spill_fn=self._spill_out, drop_fn=self._spill_drop,
         )
         self._spill_store.clear()
+
+    def save_spill_store(self, path: str) -> int:
+        """Persist the host spill tier (ledger metadata + payloads) to
+        ``path`` — restart-durable warm KV. Device-tier cache and live
+        requests are NOT saved; only already-spilled chains survive.
+        Returns the number of nodes written."""
+        return self.scheduler.pool.save_host_store(
+            path, self._spill_store,
+            meta={"kv_quant": self.kv_quant,
+                  "spill_codec": self.spill_codec},
+        )
+
+    def load_spill_store(self, path: str) -> int:
+        """Restore a :meth:`save_spill_store` file into this engine's
+        host tier: root-connected chains are adopted onto fresh host ids
+        (existing hashes win; the ``spill_blocks`` budget caps intake)
+        and their payloads installed in the spill store, so subsequent
+        admissions match straight through them and promote as usual. The
+        file's ``kv_quant``/``spill_codec`` must match this engine's —
+        payload bytes are layout-specific. Returns the number of chains
+        restored."""
+        loaded = self.scheduler.pool.load_host_store(
+            path,
+            expect_meta={"kv_quant": self.kv_quant,
+                         "spill_codec": self.spill_codec},
+        )
+        self._spill_store.update(loaded)
+        return len(loaded)
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -1340,6 +1431,8 @@ class ServingEngine:
             "calls": dict(self.calls),
             "steps": self.step_count,
             "quant": self.quant_report,
+            "kv_quant": self.kv_quant,
+            "kv_bytes_per_token": self.block_bytes // self.block_size,
             "attn_kernel": self.attn_kernel,
             "max_prefills_per_step": self.max_prefills,
             "draining": self.draining,

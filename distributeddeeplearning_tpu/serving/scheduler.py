@@ -55,6 +55,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import itertools
+import pickle
 from collections import deque
 
 
@@ -631,6 +632,118 @@ class KVBlockPool:
                 n += 1
         return n
 
+    # -- host-tier persistence --------------------------------------------
+
+    def save_host_store(self, path: str, payloads: dict,
+                        meta: dict | None = None) -> int:
+        """Persist the host spill tier: every host-tier (negative-id) node
+        whose KV payload is present in ``payloads`` (the engine's spill
+        store, chain hash -> payload) is written with its hash-chain
+        metadata. The pool stays jax-free — payloads are opaque
+        host-memory objects, serialized as-is. A node whose payload is
+        missing (capture still pending mid-step) is skipped rather than
+        persisted dangling. Returns the number of nodes written.
+
+        The file is restart-durable warm state, NOT a consistency
+        snapshot: device-tier cache and live requests are deliberately
+        excluded (their blocks die with the process)."""
+        records = []
+        for h, nd in self._cached.items():
+            if h >= 0 or nd.chain_hash not in payloads:
+                continue
+            parent_hash = (
+                self._cached[nd.parent].chain_hash
+                if nd.parent is not None else _ROOT_HASH
+            )
+            records.append({
+                "chain_hash": nd.chain_hash,
+                "parent_hash": parent_hash,
+                "depth": nd.depth,
+                "last_use": nd.last_use,
+                "payload": payloads[nd.chain_hash],
+            })
+        blob = {
+            "version": 1,
+            "block_size": self.block_size,
+            "meta": dict(meta) if meta else {},
+            "records": records,
+        }
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+        return len(records)
+
+    def load_host_store(self, path: str,
+                        expect_meta: dict | None = None) -> dict:
+        """Restore a :meth:`save_host_store` file into the host tier,
+        depth-ascending so parents adopt before children. A record is
+        taken only when its chain is ROOT-CONNECTED here — its parent hash
+        already resolves in either tier (or it is a depth-1 root child) —
+        because a dangling host node could never be matched or promoted,
+        only leak. Records whose hash is already present are skipped
+        (the live copy wins); loading stops at the ``spill_blocks``
+        budget, keeping shallowest chains (most shareable prefixes).
+        Loaded nodes enter at refcount 0 with a fresh LRU tick: saved
+        ticks belong to the dead process's clock and must not outrank
+        live traffic. Returns ``{chain_hash: payload}`` for the adopted
+        nodes — the engine installs these into its spill store.
+
+        Byte-layout-agnostic by construction: chain hashes key token
+        CONTENT, and payloads round-trip opaquely, so a store saved under
+        ``kv_quant='int8'`` restores into an int8 engine bitwise (loading
+        it into a different pool layout is the caller's error — guard
+        with the engine-level codec/layout check)."""
+        if not self.prefix_cache or not self.spill_blocks:
+            raise ValueError(
+                "load_host_store needs prefix_cache=True and "
+                "spill_blocks > 0 — there is no host tier to restore into"
+            )
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if blob.get("version") != 1:
+            raise ValueError(
+                f"host-store version {blob.get('version')!r} != 1"
+            )
+        if blob["block_size"] != self.block_size:
+            raise ValueError(
+                f"host-store block_size {blob['block_size']} != pool "
+                f"block_size {self.block_size} — chain hashes would name "
+                "different token spans"
+            )
+        if expect_meta is not None and blob.get("meta") != expect_meta:
+            raise ValueError(
+                f"host-store layout {blob.get('meta')} != this engine's "
+                f"{expect_meta} — payloads would scatter wrong bytes "
+                "into the pool"
+            )
+        # Depth-ascending with chain_hash tiebreak: deterministic, and a
+        # parent always precedes its children.
+        records = sorted(
+            blob["records"], key=lambda r: (r["depth"], r["chain_hash"])
+        )
+        self._tick += 1
+        loaded: dict[bytes, object] = {}
+        for r in records:
+            if self.spilled_blocks >= self.spill_blocks:
+                break
+            if r["chain_hash"] in self._by_hash:
+                continue
+            if r["parent_hash"] == _ROOT_HASH:
+                parent = None
+            else:
+                parent = self._by_hash.get(r["parent_hash"])
+                if parent is None:
+                    continue  # orphaned chain — unreachable, skip
+            h = self._next_hid
+            self._next_hid -= 1
+            nd = _PrefixNode(r["chain_hash"], parent, 0, self._tick,
+                             depth=r["depth"])
+            self._cached[h] = nd
+            self._by_hash[r["chain_hash"]] = h
+            if parent is not None:
+                self._cached[parent].children.add(h)
+            loaded[r["chain_hash"]] = r["payload"]
+        return loaded
+
 
 @dataclasses.dataclass
 class Request:
@@ -723,12 +836,20 @@ class Scheduler:
     retires a lane and frees its blocks. No jax anywhere.
     """
 
-    def __init__(self, slots: int, pool: KVBlockPool, max_seq_len: int):
+    def __init__(self, slots: int, pool: KVBlockPool, max_seq_len: int, *,
+                 kv_bytes_per_token: int | None = None,
+                 kv_quant: str | None = None):
         if slots < 1:
             raise ValueError(f"serving.slots must be >= 1, got {slots}")
         self.slots: list[RequestState | None] = [None] * slots
         self.pool = pool
         self.max_seq_len = max_seq_len
+        # Capacity labels (engine-provided, None = omit from gauges()):
+        # the fleet gauge merge compares replicas' KV capacity in BYTES,
+        # not blocks — with kv_quant='int8' a block holds the same tokens
+        # in ~4x fewer bytes, so block counts alone mislead the router.
+        self.kv_bytes_per_token = kv_bytes_per_token
+        self.kv_quant = kv_quant
         self.pending: deque[RequestState] = deque()
         self.finished: list[RequestState] = []
         self.dropped: list[RequestState] = []
@@ -1026,6 +1147,12 @@ class Scheduler:
             "free_blocks": self.pool.free_blocks,
             "used_blocks": self.pool.used_blocks,
         }
+        if self.kv_bytes_per_token is not None:
+            # Byte-denominated capacity: free_blocks is not comparable
+            # across replicas with different kv_quant settings.
+            g["kv_bytes_per_token"] = self.kv_bytes_per_token
+        if self.kv_quant is not None:
+            g["kv_quant"] = self.kv_quant
         if self.pool.prefix_cache:
             g["prefix_hit_rate"] = round(self.prefix_hit_rate(), 6)
             # Cache-pressure gauges: least-loaded and prefix-affinity

@@ -750,3 +750,80 @@ def test_prefix_cache_rejects_static_batching_by_name():
                         prefix_cache=True)
     with pytest.raises(NotImplementedError, match="static_batching"):
         ServingEngine(model, params, cfg, static_batching=True)
+
+
+# ---------------------------------------------------------------------------
+# Quantized-KV fence matrix (serving.kv_quant x codec/batching)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kwargs,err,match", [
+    # unknown mode fails by name, not by downstream shape error
+    (dict(kv_quant="int4"), ValueError, "kv_quant"),
+    (dict(kv_quant="fp8"), ValueError, "kv_quant"),
+    # double quantization: int8 pool blocks spilled through the int8
+    # spill codec would re-quantize already-quantized bytes — fenced as
+    # a config bug (keep spill_codec='fp', the bitwise pass-through)
+    (dict(kv_quant="int8", prefix_cache=True, spill_blocks=4,
+          spill_codec="int8"), ValueError, "kv_quant.*spill_codec"),
+])
+def test_kv_quant_fence_matrix(kwargs, err, match):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(model=ModelConfig(name="gpt2"),
+                 serving=ServingConfig(prompt_buckets=(8, 16), **kwargs))
+    with pytest.raises(err, match=match):
+        check_serving_composition(cfg)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(kv_quant="int8"),
+    # int8 pool x prefix cache: published blocks are immutable int8 +
+    # scale rows, content-addressing keys token ids, not bytes — parity
+    # pinned live in tests/test_serving.py.
+    dict(kv_quant="int8", prefix_cache=True, suffix_buckets=(4,)),
+    # int8 pool x fp spill: the spill path device_gets whatever the pool
+    # leaves hold — already-int8 payloads ride through bitwise.
+    dict(kv_quant="int8", prefix_cache=True, spill_blocks=4),
+    # int8 pool x speculation: verify reads the same dequantized pool.
+    dict(kv_quant="int8", speculation="ngram:3"),
+    # both kernels read the same quantized layout (parity pinned in
+    # tests/test_paged_attention.py).
+    dict(kv_quant="int8", attn_kernel="pallas"),
+    dict(kv_quant="int8", attn_kernel="reference"),
+])
+def test_kv_quant_legal_compositions_pass(kwargs):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(model=ModelConfig(name="gpt2"),
+                 serving=ServingConfig(prompt_buckets=(8, 16), **kwargs))
+    check_serving_composition(cfg)  # must not raise
+
+
+def test_kv_quant_rejects_static_batching_by_name():
+    # The static baseline exists as the exact-numerics anchor every
+    # continuous-batching feature is diffed against; a quantized pool
+    # would fold int8 rounding into that anchor. Engine-ctor fence (the
+    # flag is a constructor argument, invisible to the config check).
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.config import ServingConfig
+    from distributeddeeplearning_tpu.serving import ServingEngine
+
+    model = models.get_model("gpt2", size="tiny", vocab_size=97, max_len=64)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.int32)
+    )["params"]
+    cfg = ServingConfig(slots=2, block_size=4, hbm_budget_mb=8,
+                        max_seq_len=32, prompt_buckets=(8,),
+                        kv_quant="int8")
+    with pytest.raises(NotImplementedError, match="static_batching"):
+        ServingEngine(model, params, cfg, static_batching=True)

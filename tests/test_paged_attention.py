@@ -155,3 +155,106 @@ def test_shape_validation_fails_loudly():
         paged_attention(q, pk, pv, table[:1], lens)
     with pytest.raises(ValueError, match="pool_k/pool_v"):
         paged_attention(q, pk, pv[:, :4], table, lens)
+
+
+# ---------------------------------------------------------------------------
+# Quantized pools (serving.kv_quant='int8'): dequant fused into the DMA
+# ---------------------------------------------------------------------------
+
+
+def _quantize_pool(pool):
+    """Per-(slot, head) D-vector absmax int8 quantization — the same
+    layout transformer.paged_decode_attention writes: one f32 scale per
+    written (token, head) vector, so scales are [num_blocks, bs, H]."""
+    from distributeddeeplearning_tpu.comms_quant import block_quantize
+
+    nb, bs, h, d = pool.shape
+    q, s = block_quantize(jnp.asarray(pool, jnp.float32).reshape(-1), d)
+    return q.reshape(nb, bs, h, d), s.reshape(nb, bs, h)
+
+
+def _quant_case(key, **kw):
+    q, pk, pv, table, lens = _pool_case(key, **kw)
+    qk, sk = _quantize_pool(pk)
+    qv, sv = _quantize_pool(pv)
+    return q, qk, qv, table, lens, sk, sv
+
+
+def test_quantized_kernel_matches_quantized_reference():
+    # Same dequantized bytes through both lowerings: the fused in-kernel
+    # dequant must agree with the gather oracle at fp tolerance.
+    q, qk, qv, table, lens, sk, sv = _quant_case(
+        jax.random.PRNGKey(7), B=4, kv_heads=3, num_rep=1, D=16,
+        num_blocks=32, block_size=8, pages=6, lens=[0, 7, 8, 37],
+    )
+    out = paged_attention(q, qk, qv, table, lens, scale_k=sk, scale_v=sv)
+    ref = paged_attention_reference(
+        q, qk, qv, table, lens, scale_k=sk, scale_v=sv
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_quantized_vs_fp_within_drift_tolerance():
+    # int8 rounding against the full-precision pool: per-vector absmax
+    # keeps unit-normal attention outputs well inside the 0.05 drift bar
+    # the engine probe pins (ISSUE acceptance).
+    key = jax.random.PRNGKey(8)
+    args = _pool_case(
+        key, B=3, kv_heads=2, num_rep=2, D=32,
+        num_blocks=16, block_size=8, pages=4, lens=[5, 16, 23],
+    )
+    q, pk, pv, table, lens = args
+    fp = paged_attention(q, pk, pv, table, lens, num_rep=2)
+    qk, sk = _quantize_pool(pk)
+    qv, sv = _quantize_pool(pv)
+    q8 = paged_attention(q, qk, qv, table, lens, num_rep=2,
+                         scale_k=sk, scale_v=sv)
+    assert float(jnp.max(jnp.abs(q8 - fp))) < 0.05
+
+
+def test_quantized_gqa_mixed_depths_and_idle_rows():
+    # GQA group sharing, cursors at boundary/mid-page/deep, and an idle
+    # row parked on the null block — all under the int8 layout. The null
+    # block's scales are ZERO (never written): the dequantized row is
+    # exactly 0, matching the fp pool's zero null block, and the idle
+    # row's output stays finite.
+    q, qk, qv, table, lens, sk, sv = _quant_case(
+        jax.random.PRNGKey(9), B=4, kv_heads=2, num_rep=4, D=16,
+        num_blocks=32, block_size=8, pages=6, lens=[0, 7, 24, 37],
+    )
+    zero = jnp.zeros_like(sk[0])
+    sk = sk.at[0].set(zero)
+    sv = sv.at[0].set(zero)
+    out = paged_attention(q, qk, qv, table, lens, num_rep=4,
+                          scale_k=sk, scale_v=sv)
+    assert bool(jnp.isfinite(out).all())
+    ref = paged_attention_reference(
+        q, qk, qv, table, lens, num_rep=4, scale_k=sk, scale_v=sv
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_scale_buffer_validation_fails_loudly():
+    q, qk, qv, table, lens, sk, sv = _quant_case(
+        jax.random.PRNGKey(10), B=2, kv_heads=2, num_rep=1, D=16,
+        num_blocks=8, block_size=8, pages=2, lens=[1, 9],
+    )
+    fp_k = qk.astype(jnp.float32)
+    # int8 pool without scales: silent garbage without the fence.
+    with pytest.raises(ValueError, match="scale"):
+        paged_attention(q, qk, qv, table, lens)
+    # scales beside a non-int8 pool: caller confusion, not a layout.
+    with pytest.raises(ValueError, match="int8"):
+        paged_attention(q, fp_k, fp_k, table, lens,
+                        scale_k=sk, scale_v=sv)
+    # wrong scale shape (per-page instead of per-slot): fail by shape.
+    with pytest.raises(ValueError, match="scale_k"):
+        paged_attention(q, qk, qv, table, lens,
+                        scale_k=sk[:, 0], scale_v=sv[:, 0])
+    # the reference oracle enforces the same contract
+    with pytest.raises(ValueError, match="scale"):
+        paged_attention_reference(q, qk, qv, table, lens)

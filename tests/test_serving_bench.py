@@ -42,6 +42,15 @@ leg checks per-row tier conservation, fp token parity (incl. under the
 tight host budget), the exactly-0.0 adversarial hit rate, and the
 unchanged compile pin; the >= 2x hit-token recovery headline is pinned
 on the committed artifact.
+
+PR 17 adds the ``kv_quant`` block: the device pool itself quantized
+(serving.kv_quant='int8') replaying the standard trace (token parity
+vs the fp continuous row) and the constrained shared-prefix trace with
+and without the spill tier, plus the random-byte adversarial control
+and a cached-prefix logit-drift probe. The smoke leg checks per-row
+layout columns, parity on the standard trace, the 0.0 control, and
+both compile pins; the >= 2x block-capacity headline and the shared-
+trace parity are pinned on the committed artifact.
 """
 
 import json
@@ -144,6 +153,7 @@ def _check_shape(rec, n_requests):
     _check_router_shape(rec)
     _check_prefix_shape(rec)
     _check_kv_shape(rec)
+    _check_kvq_shape(rec)
 
 
 def _check_prefix_shape(rec):
@@ -218,6 +228,35 @@ def _check_kv_shape(rec):
     assert probe["ok"] is True
     assert probe["max_rel_drift"] <= probe["tolerance"]
     assert comp["zero_recompiles_with_spill"] is True
+
+
+def _check_kvq_shape(rec):
+    kvq = rec["kv_quant"]
+    std, int8, spill, adv = kvq["rows"]
+    comp = kvq["comparison"]
+    # Every row in this block runs an int8 pool; the fp baselines are
+    # the reused `continuous` and kv_hierarchy spill-off rows.
+    assert std["kv_quant"] == "int8"
+    assert std["constrained_blocks"] is None
+    for row in (int8, spill, adv):
+        assert row["kv_quant"] == "int8"
+        assert row["constrained_blocks"] == kvq["device_blocks"]
+    assert spill["prefix"]["spill_budget"] == kvq["spill_blocks"]
+    # int8 blocks are smaller, so the same HBM budget mints more of
+    # them — the per-token byte column is the reason why.
+    assert comp["num_blocks_int8"] > comp["num_blocks_fp"]
+    assert comp["kv_bytes_per_token_int8"] < comp["kv_bytes_per_token_fp"]
+    # Quantized KV never changes the tokens on the standard trace, and
+    # the adversarial control never reuses quantized KV at all.
+    assert comp["tokens_match_fp_reference"] is True
+    assert comp["adversarial_hit_rate"] == 0.0
+    probe = comp["logit_drift_probe"]
+    assert probe["ok"] is True
+    assert probe["max_rel_drift"] <= probe["tolerance"]
+    # Dequant is fused into the same programs: both pins unchanged.
+    assert (std["compiles_after_run"] == std["compiles_warmup"]
+            == comp["compile_pin_standard"])
+    assert comp["zero_recompiles_with_kv_quant"] is True
 
 
 def _check_router_shape(rec):
@@ -328,3 +367,10 @@ def test_bench_serving_artifact():
     assert kvc["hit_token_recovery_spill_fp"] >= 2.0
     assert kvc["spills_spill_fp"] > 0
     assert kvc["int8_promotes"] > 0
+    # Quantized-pool headline (the same HBM budget): >= 2x the minted
+    # blocks, token parity on the reuse-heavy shared trace too, and the
+    # spill tier still recovering >= 2x on top of the int8 pool.
+    qc = rec["kv_quant"]["comparison"]
+    assert qc["block_capacity_ratio_int8"] >= 2.0
+    assert qc["tokens_match_fp_shared"] is True
+    assert qc["spill_hit_token_recovery_int8"] >= 2.0

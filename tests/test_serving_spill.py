@@ -561,3 +561,78 @@ def test_static_batching_rejects_spill_by_name(gpt2):
     model, params = gpt2
     with pytest.raises(NotImplementedError, match="static_batching"):
         ServingEngine(model, params, _CFG, static_batching=True)
+
+
+# ---------------------------------------------------------------------------
+# Engine: spill-store persistence (save_spill_store / load_spill_store)
+# ---------------------------------------------------------------------------
+
+
+def _spill_then_save(model, params, shared, tail, path, *, cfg=_CFG):
+    """Run the shared prompt, churn the constrained pool until its chain
+    lives on the host tier, then persist the store."""
+    rng = np.random.default_rng(5)
+    eng = _engine(model, params, cfg)
+    eng.constrain_pool(8)
+    eng.submit(Request(prompt=shared + tail, max_new_tokens=8))
+    eng.run()
+    for _ in range(3):  # unrelated traffic squeezes the chain out
+        p = list(map(int, rng.integers(1, 97, 15)))
+        eng.submit(Request(prompt=p, max_new_tokens=8))
+        eng.run()
+    st = eng.stats()["prefix_cache"]
+    assert st["spilled_blocks"] > 0
+    n = eng.save_spill_store(path)
+    assert n == st["spill_store_blocks"]
+    return eng
+
+
+@pytest.mark.parametrize("kv_quant", ["off", "int8"])
+def test_spill_store_round_trip_parity_vs_never_restarted(
+    kv_quant, tmp_path
+):
+    # A restarted engine that loads the persisted host tier must serve
+    # the old traffic's prefix FROM that tier (real promotes, not a
+    # re-prefill that happens to agree) and emit exactly what a
+    # never-restarted engine emits — for the fp pool bitwise, and for
+    # the int8 pool because spilled payloads are already-quantized bytes
+    # that ride through the fp codec unchanged.
+    cfg = dataclasses.replace(_CFG, kv_quant=kv_quant)
+    model, params = _model_and_params()
+    rng = np.random.default_rng(4)
+    shared = list(map(int, rng.integers(1, 97, 12)))
+    tail = list(map(int, rng.integers(1, 97, 3)))
+    path = str(tmp_path / "store.pkl")
+    _spill_then_save(model, params, shared, tail, path, cfg=cfg)
+
+    restarted = _engine(model, params, cfg)
+    restarted.constrain_pool(8)
+    assert restarted.load_spill_store(path) > 0
+    restarted.submit(Request(prompt=shared + tail, max_new_tokens=8))
+    (done_r,) = restarted.run()
+    # The hit really came from the restored host tier.
+    assert restarted.stats()["prefix_cache"]["promotes"] > 0
+    assert restarted.scheduler.prefix_hit_tokens_host > 0
+
+    cold = _engine(model, params, cfg)
+    cold.constrain_pool(8)
+    cold.submit(Request(prompt=shared + tail, max_new_tokens=8))
+    (done_c,) = cold.run()
+    assert done_r.generated == done_c.generated
+
+
+def test_spill_store_load_rejects_layout_mismatch(tmp_path):
+    # A store saved under kv_quant='int8' holds int8+scale pool rows; a
+    # kv_quant='off' engine scattering them would corrupt the pool. The
+    # loader fails by name instead.
+    model, params = _model_and_params()
+    rng = np.random.default_rng(4)
+    shared = list(map(int, rng.integers(1, 97, 12)))
+    tail = list(map(int, rng.integers(1, 97, 3)))
+    cfg = dataclasses.replace(_CFG, kv_quant="int8")
+    path = str(tmp_path / "store.pkl")
+    _spill_then_save(model, params, shared, tail, path, cfg=cfg)
+    plain = _engine(model, params, _CFG)
+    with pytest.raises(ValueError, match="layout"):
+        plain.load_spill_store(path)
+    assert len(plain._spill_store) == 0  # nothing partially installed

@@ -333,6 +333,29 @@ def test_gauges_deadline_headroom_is_min_over_queued():
     assert s.gauges(6.0)["queued_deadline_headroom_s"] == pytest.approx(-2.0)
 
 
+def test_gauges_kv_capacity_labels_appear_only_when_provided():
+    # Engine-provided capacity labels: block counts are not comparable
+    # across replicas with different kv_quant, so the fleet merge needs
+    # bytes-per-token beside them. Absent by default (back-compat with
+    # the four-gauge shape).
+    plain = _sched()
+    g = plain.gauges()
+    assert "kv_bytes_per_token" not in g and "kv_quant" not in g
+    s = Scheduler(2, KVBlockPool(64, 4), 32,
+                  kv_bytes_per_token=320, kv_quant="int8")
+    g = s.gauges()
+    assert g["kv_bytes_per_token"] == 320
+    assert g["kv_quant"] == "int8"
+
+
+def test_gauges_kv_labels_ride_through_now_variant():
+    s = Scheduler(2, KVBlockPool(64, 4), 32,
+                  kv_bytes_per_token=1024, kv_quant="off")
+    g = s.gauges(5.0)
+    assert g["kv_bytes_per_token"] == 1024 and g["kv_quant"] == "off"
+    assert "oldest_queued_age_s" in g
+
+
 # ---------------------------------------------------------------------------
 # Prefix cache: content-addressed trie over the block pool
 # ---------------------------------------------------------------------------
@@ -837,3 +860,122 @@ def test_no_block_leaks_three_tier_1k():
     assert s.pool.spills > 0 and s.pool.promotes > 0
     assert s.pool.final_evictions > 0  # the cap actually bit
     assert len(s.finished) == 1000
+
+
+# ---------------------------------------------------------------------------
+# Host-tier persistence (save_host_store / load_host_store)
+# ---------------------------------------------------------------------------
+
+
+def _spilled_pool(tmp_path=None, *, num_blocks=8, spill_blocks=6,
+                  chains=((1, 1, 1, 1, 2, 2, 2, 2), (3, 3, 3, 3))):
+    """A pool with ``chains`` published then squeezed out to the host
+    tier, plus the engine-store mimic dict the callbacks filled."""
+    store: dict[bytes, object] = {}
+    pool = KVBlockPool(num_blocks, 4, prefix_cache=True,
+                       spill_blocks=spill_blocks,
+                       spill_fn=lambda pairs: store.update(
+                           {h: f"kv:{h.hex()}" for _, h in pairs}
+                       ),
+                       drop_fn=store.pop)
+    for c in chains:
+        _seed_chain(pool, list(c))
+    got = pool.alloc(num_blocks - 1)  # evict everything refcount-0
+    pool.free(got)
+    assert pool.spilled_blocks == sum(len(c) // 4 for c in chains)
+    return pool, store
+
+
+def test_host_store_round_trip_restores_chains_and_payloads(tmp_path):
+    pool, store = _spilled_pool()
+    path = str(tmp_path / "spill.pkl")
+    assert pool.save_host_store(path, store) == 3
+    fresh = KVBlockPool(8, 4, prefix_cache=True, spill_blocks=6)
+    loaded = fresh.load_host_store(path)
+    # Every chain is root-connected here, so everything comes back, with
+    # the exact payload objects keyed by chain hash.
+    assert loaded == store
+    assert fresh.spilled_blocks == 3
+    # The restored trie matches the original prompts through the host
+    # tier — the whole point of persistence.
+    assert len(fresh.match([1, 1, 1, 1, 2, 2, 2, 2, 9])) == 2
+    assert len(fresh.match([3, 3, 3, 3, 9])) == 1
+    assert fresh.match([4, 4, 4, 4, 9]) == []
+
+
+def test_host_store_load_skips_existing_and_respects_cap(tmp_path):
+    pool, store = _spilled_pool()
+    path = str(tmp_path / "spill.pkl")
+    pool.save_host_store(path, store)
+    # A pool that already holds chain [3,3,3,3] keeps its live copy.
+    fresh = KVBlockPool(8, 4, prefix_cache=True, spill_blocks=6)
+    _seed_chain(fresh, [3, 3, 3, 3])
+    loaded = fresh.load_host_store(path)
+    assert len(loaded) == 2  # only the [1,1,...] chain's two blocks
+    assert fresh.cached_blocks == 1 and fresh.spilled_blocks == 2
+    # A 1-slot host budget takes only the shallowest chain block.
+    tight = KVBlockPool(8, 4, prefix_cache=True, spill_blocks=1)
+    loaded = tight.load_host_store(path)
+    assert len(loaded) == 1 and tight.spilled_blocks == 1
+    assert len(tight.match([1, 1, 1, 1, 9])) + len(
+        tight.match([3, 3, 3, 3, 9])
+    ) == 1  # exactly one depth-1 block restored
+
+
+def test_host_store_skips_orphans_and_pending_captures(tmp_path):
+    pool, store = _spilled_pool()
+    # Drop one payload to mimic a capture still pending mid-step: its
+    # node must not be persisted dangling, and the child it parents
+    # becomes an orphan the loader must skip.
+    parent_hash = next(
+        nd.chain_hash for b, nd in pool._cached.items()
+        if b < 0 and nd.parent is None and nd.children
+    )
+    del store[parent_hash]
+    path = str(tmp_path / "spill.pkl")
+    assert pool.save_host_store(path, store) == 2
+    fresh = KVBlockPool(8, 4, prefix_cache=True, spill_blocks=6)
+    loaded = fresh.load_host_store(path)
+    # The orphaned depth-2 child is skipped; the independent chain loads.
+    assert len(loaded) == 1 and fresh.spilled_blocks == 1
+    assert len(fresh.match([3, 3, 3, 3, 9])) == 1
+    assert fresh.match([1, 1, 1, 1, 9]) == []
+
+
+def test_host_store_loaded_nodes_get_fresh_ticks_refcount_zero(tmp_path):
+    pool, store = _spilled_pool()
+    path = str(tmp_path / "spill.pkl")
+    pool.save_host_store(path, store)
+    fresh = KVBlockPool(8, 4, prefix_cache=True, spill_blocks=6)
+    tick_before = fresh._tick
+    fresh.load_host_store(path)
+    for b, nd in fresh._cached.items():
+        assert b < 0 and nd.refs == 0
+        # Saved ticks belong to the dead process's clock: every loaded
+        # node enters at this pool's next tick, not an inherited one.
+        assert nd.last_use == tick_before + 1
+
+
+def test_host_store_rejects_block_size_and_meta_mismatch(tmp_path):
+    pool, store = _spilled_pool()
+    path = str(tmp_path / "spill.pkl")
+    pool.save_host_store(path, store, meta={"kv_quant": "int8"})
+    wrong_bs = KVBlockPool(8, 8, prefix_cache=True, spill_blocks=6)
+    with pytest.raises(ValueError, match="block_size"):
+        wrong_bs.load_host_store(path)
+    fresh = KVBlockPool(8, 4, prefix_cache=True, spill_blocks=6)
+    with pytest.raises(ValueError, match="layout"):
+        fresh.load_host_store(path, expect_meta={"kv_quant": "off"})
+    assert fresh.spilled_blocks == 0  # nothing partially adopted
+    # Matching meta loads fine.
+    assert len(fresh.load_host_store(
+        path, expect_meta={"kv_quant": "int8"}
+    )) == 3
+
+
+def test_host_store_load_requires_a_host_tier(tmp_path):
+    pool, store = _spilled_pool()
+    path = str(tmp_path / "spill.pkl")
+    pool.save_host_store(path, store)
+    with pytest.raises(ValueError, match="spill_blocks"):
+        KVBlockPool(8, 4, prefix_cache=True).load_host_store(path)

@@ -91,6 +91,41 @@ print("PAGED_ATTN_TPU_OK")
     assert "PAGED_ATTN_TPU_OK" in out
 
 
+def test_paged_attention_int8_compiles_on_tpu():
+    # Native Mosaic compile of the QUANTIZED decode kernel (serving.
+    # kv_quant='int8'): the per-page DMA pulls the int8 page plus its
+    # per-(slot, head) f32 scale row into VMEM and dequantizes inline
+    # before the online softmax. Parity is checked against the fused fp
+    # kernel on the SAME logical KV — int8 rounding only, which the
+    # engine's drift probe bounds at 0.05.
+    out = run_on_tpu("""
+import jax, jax.numpy as jnp, numpy as np
+from distributeddeeplearning_tpu.ops import paged_attention
+from distributeddeeplearning_tpu.comms_quant import block_quantize
+assert jax.default_backend() == "tpu", jax.default_backend()
+B, G, R, D, NB, BS, P = 4, 2, 4, 128, 16, 16, 4
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q = jax.random.normal(ks[0], (B, G * R, D), jnp.bfloat16)
+pk = jax.random.normal(ks[1], (NB, BS, G, D), jnp.float32)
+pv = jax.random.normal(ks[2], (NB, BS, G, D), jnp.float32)
+qk, sk = block_quantize(pk.reshape(-1), D)
+qv, sv = block_quantize(pv.reshape(-1), D)
+qk, sk = qk.reshape(NB, BS, G, D), sk.reshape(NB, BS, G)
+qv, sv = qv.reshape(NB, BS, G, D), sv.reshape(NB, BS, G)
+table = jnp.asarray([[0]*P, [1, 2, 0, 0], [3, 4, 5, 0], [6, 7, 8, 9]], jnp.int32)
+lens = jnp.asarray([0, 17, 40, 63], jnp.int32)
+out = jax.jit(lambda *a: paged_attention(
+    *a[:5], num_rep=R, scale_k=a[5], scale_v=a[6], interpret=False))(
+    q, qk, qv, table, lens, sk, sv)
+fp = jax.jit(lambda *a: paged_attention(*a, num_rep=R, interpret=False))(
+    q, pk.astype(jnp.bfloat16), pv.astype(jnp.bfloat16), table, lens)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - fp.astype(jnp.float32))))
+assert err < 0.05, err
+print("PAGED_ATTN_INT8_TPU_OK", err)
+""")
+    assert "PAGED_ATTN_INT8_TPU_OK" in out
+
+
 def test_fused_adamw_compiles_on_tpu():
     out = run_on_tpu("""
 import jax, jax.numpy as jnp, optax

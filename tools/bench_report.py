@@ -28,10 +28,11 @@ $DDL_REPORT_OUT).
 artifacts this index points at without re-measuring: today that means
 BENCH_SERVING.json's router block (the scale-out + shedding claims),
 prefix_cache block (the shared-prefix KV-reuse reduction, parity, and
-adversarial control), and kv_hierarchy block (the spill-tier hit-token
-recovery, fp parity, and int8 controls), and, when
-BENCH_TRAJECTORY.json exists, that its serving entry actually carries
-the router, prefix, and kv headlines — an
+adversarial control), kv_hierarchy block (the spill-tier hit-token
+recovery, fp parity, and int8 controls), and kv_quant block (the
+quantized device pool's >= 2x block-capacity ratio, token parity, and
+drift probe), and, when BENCH_TRAJECTORY.json exists, that its serving
+entry actually carries the router, prefix, kv, and kv_quant headlines — an
 index that silently drops a headline it was grown to surface is a
 regression. Exits non-zero listing every failure.
 """
@@ -130,6 +131,18 @@ def _headline(rec: dict) -> dict:
         probe = kv["comparison"].get("int8_logit_probe")
         if isinstance(probe, dict):
             out["kv_int8_max_rel_drift"] = probe.get("max_rel_drift")
+    # Serving kv-quant block: the quantized-pool headline — budget-minted
+    # blocks int8 over fp at the same HBM budget, token parity on the
+    # standard trace, and the cached-prefix read-path drift.
+    kvq = rec.get("kv_quant")
+    if isinstance(kvq, dict) and isinstance(kvq.get("comparison"), dict):
+        for k in ("block_capacity_ratio_int8", "tokens_match_fp_reference",
+                  "adversarial_hit_rate", "kv_bytes_per_token_int8"):
+            if k in kvq["comparison"]:
+                out["kvq_" + k] = kvq["comparison"][k]
+        probe = kvq["comparison"].get("logit_drift_probe")
+        if isinstance(probe, dict):
+            out["kvq_max_rel_drift"] = probe.get("max_rel_drift")
     # FLEET.json (tools/telemetry_report.py fleet rehearsal): the pod-level
     # headline the aggregator exists for.
     fh = rec.get("headline")
@@ -258,6 +271,24 @@ def check() -> int:
           (kcomp.get("int8_logit_probe") or {}).get("ok") is True)
     claim("kv zero_recompiles_with_spill",
           kcomp.get("zero_recompiles_with_spill") is True)
+    # The kv-quant block (quantized device pool): the capacity headline,
+    # token parity, the read-path drift probe, and the honest control.
+    qcomp = serving.get("kv_quant", {}).get("comparison", {})
+    claim("kv_quant block present", bool(qcomp))
+    claim("kvq block_capacity_ratio_int8 >= 2.0",
+          (qcomp.get("block_capacity_ratio_int8") or 0) >= 2.0)
+    claim("kvq tokens_match_fp_reference",
+          qcomp.get("tokens_match_fp_reference") is True)
+    claim("kvq tokens_match_fp_shared",
+          qcomp.get("tokens_match_fp_shared") is True)
+    claim("kvq spill_hit_token_recovery_int8 >= 2.0",
+          (qcomp.get("spill_hit_token_recovery_int8") or 0) >= 2.0)
+    claim("kvq adversarial_hit_rate == 0.0",
+          qcomp.get("adversarial_hit_rate") == 0.0)
+    claim("kvq logit_drift_probe ok",
+          (qcomp.get("logit_drift_probe") or {}).get("ok") is True)
+    claim("kvq zero_recompiles_with_kv_quant",
+          qcomp.get("zero_recompiles_with_kv_quant") is True)
 
     # The index, when committed, must surface the router headline for the
     # serving artifact (the whole point of indexing it).
@@ -284,6 +315,12 @@ def check() -> int:
         claim("trajectory carries kv_int8_adversarial_hit_rate",
               head.get("kv_int8_adversarial_hit_rate")
               == kcomp.get("int8_adversarial_hit_rate"))
+        claim("trajectory carries kvq_block_capacity_ratio_int8",
+              head.get("kvq_block_capacity_ratio_int8")
+              == qcomp.get("block_capacity_ratio_int8"))
+        claim("trajectory carries kvq_tokens_match_fp_reference",
+              head.get("kvq_tokens_match_fp_reference")
+              == qcomp.get("tokens_match_fp_reference"))
 
     if failures:
         print(f"bench_report --check: {len(failures)} claim(s) FAILED:")
