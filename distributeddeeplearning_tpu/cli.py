@@ -681,6 +681,164 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _fleet_plan(config: str, overrides: list[str], fleet: int, *,
+                host: str = "127.0.0.1", port_base: int = 0,
+                telemetry_dir: str | None = None,
+                base_env: dict | None = None):
+    """``[(cmd, env), ...]`` for every worker of ``cli serve --fleet N``
+    — pure (no processes spawned), so tests can pin the plan.
+
+    Workers are ``serving.worker`` invocations (one ServingEngine
+    process each) reusing the ``cli launch`` child conventions: every
+    child gets ``DDL_PROCESS_INDEX=i`` (the telemetry fleet stamp, so N
+    workers sharing one telemetry dir write non-clobbering artifacts
+    that ``telemetry_aggregate.build_fleet`` merges) and the coordinated
+    -launch env vars are scrubbed — a fleet worker is single-process by
+    construction."""
+    import os
+
+    plan = []
+    for i in range(fleet):
+        cmd = [
+            sys.executable, "-m",
+            "distributeddeeplearning_tpu.serving.worker",
+            "--config", config,
+            "--replica-index", str(i),
+            "--host", host,
+            "--port", str(port_base + i if port_base else 0),
+        ]
+        for o in overrides:
+            cmd += ["--override", o]
+        if telemetry_dir:
+            cmd += ["--telemetry-dir", telemetry_dir]
+        env = dict(os.environ if base_env is None else base_env)
+        for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+            env.pop(k, None)
+        env["DDL_PROCESS_INDEX"] = str(i)
+        plan.append((cmd, env))
+    return plan
+
+
+def read_worker_ready(stream, *, echo=None) -> dict:
+    """Scan a worker's stdout for its single ``worker_ready`` JSON line
+    (passing any other output through ``echo``); raises on EOF."""
+    for line in iter(stream.readline, ""):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = None
+        if isinstance(rec, dict) and rec.get("event") == "worker_ready":
+            return rec
+        if echo is not None:
+            echo(line)
+    raise RuntimeError(
+        "fleet worker exited before reporting worker_ready"
+    )
+
+
+def cmd_serve_fleet(args) -> int:
+    """``cli serve --fleet N``: spawn N ``serving.worker`` processes
+    (launch-style child machinery), dial their sockets, and serve the
+    prompt batch through a ReplicaRouter whose replicas are
+    SocketReplica transports — the cross-process counterpart of the
+    in-process ``serving.replicas`` path, same dispatch/shed/drain/
+    quarantine policy code. Like ``launch``, this runs BEFORE
+    init_distributed: the parent is a process babysitter plus a socket
+    client; the engines (and devices) belong to the children."""
+    import subprocess
+    import threading
+
+    from .config import apply_overrides, load_config
+    from .serving import (
+        Request,
+        check_fleet_composition,
+        check_serving_composition,
+        connect_fleet,
+    )
+    from .telemetry import resolve_dir
+
+    cfg = apply_overrides(load_config(args.config), args.override)
+    # Composition fences FIRST — fail by name before any child spawns.
+    check_serving_composition(cfg)
+    check_fleet_composition(cfg.serving, args.fleet)
+    if (args.temperature > 0
+            and getattr(cfg.serving, "speculation", "off") != "off"):
+        raise NotImplementedError(
+            "cli serve --temperature > 0 x serving.speculation: "
+            "speculative serving is greedy-only — drop --temperature or "
+            "set serving.speculation=off"
+        )
+    if any(not p for p in args.prompt):
+        raise ValueError("prompt must be non-empty")
+    tdir = resolve_dir(cfg) if cfg.telemetry.enabled else None
+    plan = _fleet_plan(
+        args.config, args.override, args.fleet,
+        host=cfg.serving.worker_host,
+        port_base=cfg.serving.worker_port,
+        telemetry_dir=tdir,
+    )
+    procs, threads, endpoints = [], [], []
+    try:
+        for i, (cmd, env) in enumerate(plan):
+            p = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            procs.append(p)
+        for i, p in enumerate(procs):
+            ready = read_worker_ready(
+                p.stdout,
+                echo=lambda line, i=i: sys.stdout.write(f"[w{i}] {line}"),
+            )
+            endpoints.append((ready["host"], ready["port"]))
+            t = threading.Thread(
+                target=_stream_prefixed,
+                args=(p.stdout, f"[w{i}] ", sys.stdout),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        router = connect_fleet(cfg.serving, endpoints)
+        for p_text in args.prompt:
+            router.submit(Request(
+                prompt=list(p_text.encode("utf-8")),
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p,
+            ))
+        finished = router.run()
+        stats, events = router.stats(), router.events
+        router.shutdown_fleet()
+    finally:
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.terminate()
+    rcs = [p.wait() for p in procs]
+    for t in threads:
+        t.join(timeout=5)
+    results = []
+    for st in finished:
+        m = st.metrics()
+        m["prompt"] = bytes(st.request.prompt).decode("utf-8", "replace")
+        m["completion"] = bytes(
+            t for t in st.generated if 0 <= t < 256
+        ).decode("utf-8", errors="replace")
+        results.append(m)
+    record = {
+        "fleet": args.fleet,
+        "results": results,
+        "stats": stats,
+        "events": events,
+        "worker_exit_codes": rcs,
+    }
+    if tdir:
+        record["telemetry_dir"] = tdir
+    print(json.dumps(record))
+    return max(rcs) if rcs else 0
+
+
 def _launch_plan(config: str, overrides: list[str], num_processes: int,
                  *, devices_per_process: int = 0, coordinator_port: int = 0,
                  xla_perf_flags: bool = False, base_env: dict | None = None,
@@ -838,6 +996,13 @@ def main(argv=None) -> int:
             p.add_argument("--top-k", type=int, default=0)
             p.add_argument("--top-p", type=float, default=0.0)
             p.add_argument("--seed", type=int, default=0)
+        if name == "serve":
+            p.add_argument(
+                "--fleet", type=int, default=0,
+                help="spawn N serving.worker child processes and route "
+                "over sockets (cross-process fleet; docs/SERVING.md). "
+                "0 = in-process serving.replicas path",
+            )
         if name == "generate":
             p.add_argument(
                 "--bench", action="store_true",
@@ -892,6 +1057,10 @@ def main(argv=None) -> int:
         # Same reason: the launcher is a pure process babysitter — the
         # backend and coordinator rendezvous belong to its children.
         return cmd_launch(args)
+    if args.cmd == "serve" and args.fleet:
+        # Same reason again: the fleet parent is a babysitter plus a
+        # socket client; the engines (and devices) live in the workers.
+        return cmd_serve_fleet(args)
     if args.xla_perf_flags:
         # Env-level, so it must precede EVERY backend touch — including the
         # rendezvous below and anything a config module might do.

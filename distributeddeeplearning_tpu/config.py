@@ -438,6 +438,27 @@ class ServingConfig:
     # latency feeds the shed feasibility estimate. Higher = more
     # conservative admission = more shedding.
     shed_percentile: float = 50.0
+    # Cross-process fleet (serving/worker.py + serving/net.py; ``cli
+    # serve --fleet N``): each replica is a real child process serving
+    # one engine behind a length-prefixed-JSON socket. The knobs below
+    # only matter on that path — in-process replicas probe gauges
+    # directly and never heartbeat.
+    #
+    # Seconds between a worker's pushed heartbeats (scheduler gauges +
+    # prefix-trie digest summary). Must be > 0 when a fleet is launched
+    # — fenced by name in check_fleet_composition.
+    heartbeat_interval_s: float = 0.05
+    # Quarantine a socket replica after this many seconds without a
+    # heartbeat: its queued (never-admitted) requests reroute to the
+    # survivors, its in-flight requests are reported lost. 0 disables
+    # staleness quarantine; when > 0 it must exceed
+    # heartbeat_interval_s — fenced by name.
+    heartbeat_timeout_s: float = 1.0
+    # Interface fleet workers bind/advertise. Workers always bind an
+    # ephemeral port unless worker_port > 0 (then worker i binds
+    # worker_port + i).
+    worker_host: str = "127.0.0.1"
+    worker_port: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

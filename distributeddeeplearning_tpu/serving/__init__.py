@@ -13,10 +13,19 @@ from .engine import (  # noqa: F401
     check_serving_composition,
     speculation_k,
 )
+from .net import (  # noqa: F401
+    MAX_FRAME_BYTES,
+    FrameDecoder,
+    ProtocolError,
+    encode_frame,
+)
 from .router import (  # noqa: F401
     Replica,
     ReplicaRouter,
     RequestShed,
+    SocketReplica,
+    StaleHeartbeat,
+    connect_fleet,
 )
 from .quant import (  # noqa: F401
     dequantize_params,
@@ -32,3 +41,17 @@ from .scheduler import (  # noqa: F401
     chain_digests,
     ngram_draft,
 )
+
+_WORKER_EXPORTS = ("ReplicaWorker", "check_fleet_composition")
+
+
+def __getattr__(name):
+    # Lazy so `python -m ...serving.worker` (the fleet child entrypoint)
+    # does not double-execute worker.py: once via this package import,
+    # once as __main__ (runpy would warn, and module-level state would
+    # exist twice).
+    if name in _WORKER_EXPORTS:
+        from . import worker
+
+        return getattr(worker, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1051,6 +1051,12 @@ class ServingEngine:
         Matches through the host tier, like admission."""
         return self.scheduler.pool.match_digests(digests) * self.block_size
 
+    def prefix_digest_summary(self, limit: int = 0) -> list[bytes]:
+        """The trie digest set (MRU-first, capped at ``limit``) a fleet
+        worker ships in its heartbeat — see
+        ``KVBlockPool.digest_summary``. Empty with the cache off."""
+        return self.scheduler.pool.digest_summary(limit)
+
     def drain(self) -> None:
         """Graceful shutdown intake cut (the router's elastic-membership
         primitive, docs/SERVING.md): everything already accepted — queued

@@ -1,0 +1,189 @@
+"""Wire framing for the cross-process serving fleet.
+
+One frame = a 4-byte big-endian unsigned length followed by that many
+bytes of UTF-8 JSON:
+
+    +----------------+---------------------------+
+    | len (u32, BE)  |  payload: UTF-8 JSON body |
+    +----------------+---------------------------+
+
+The codec is deliberately boring — stdlib sockets, stdlib json — and it
+lives apart from any socket so the framing itself is unit-testable on
+plain byte buffers (tests/test_serving_net.py): :class:`FrameDecoder`
+accepts arbitrary partial reads and yields complete objects as they
+close, which is exactly the shape a nonblocking ``recv`` loop produces.
+
+Every malformed input path raises :class:`ProtocolError` BY NAME —
+oversized declared length (before buffering a byte of the payload),
+payload that is not valid JSON, a frame that closes mid-payload. A
+router or worker treats any ``ProtocolError`` on a connection as that
+peer being gone: there is no resync point inside a corrupted
+length-prefixed stream.
+
+``MAX_FRAME_BYTES`` bounds a single frame (default 16 MiB): the largest
+legitimate frame is a heartbeat digest summary or a batch of result
+token lists, both tiny. The bound is what turns a corrupt or hostile
+length word into a typed error instead of an OOM.
+"""
+
+from __future__ import annotations
+
+import json
+import select
+import socket
+import struct
+
+MAX_FRAME_BYTES = 16 << 20
+
+_LEN = struct.Struct(">I")
+
+
+class ProtocolError(RuntimeError):
+    """Typed wire-protocol violation: oversized frame, malformed JSON
+    payload, or a stream that ended mid-frame. Not retryable — the
+    stream has no resync point, so the connection is dead."""
+
+
+def encode_frame(obj, *, max_bytes: int = MAX_FRAME_BYTES) -> bytes:
+    """One length-prefixed frame for ``obj`` (compact JSON)."""
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) > max_bytes:
+        raise ProtocolError(
+            f"frame payload {len(payload)} bytes exceeds max_bytes "
+            f"{max_bytes} — refusing to send an unreceivable frame"
+        )
+    return _LEN.pack(len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental frame parser over arbitrary byte chunks.
+
+    ``feed(data)`` buffers ``data`` and returns every frame that is now
+    complete (possibly none, possibly several) — short reads, split
+    length words, and multiple frames per chunk all just work. State is
+    a single bytearray; the declared length is validated against
+    ``max_bytes`` as soon as the 4-byte prefix is readable, BEFORE the
+    payload is buffered.
+    """
+
+    def __init__(self, *, max_bytes: int = MAX_FRAME_BYTES):
+        self.max_bytes = int(max_bytes)
+        self._buf = bytearray()
+
+    @property
+    def buffered(self) -> int:
+        return len(self._buf)
+
+    def feed(self, data: bytes) -> list:
+        self._buf.extend(data)
+        out = []
+        while True:
+            if len(self._buf) < _LEN.size:
+                break
+            (n,) = _LEN.unpack_from(self._buf)
+            if n > self.max_bytes:
+                raise ProtocolError(
+                    f"declared frame length {n} exceeds max_bytes "
+                    f"{self.max_bytes} — corrupt stream or hostile peer"
+                )
+            if len(self._buf) < _LEN.size + n:
+                break
+            payload = bytes(self._buf[_LEN.size:_LEN.size + n])
+            del self._buf[:_LEN.size + n]
+            try:
+                out.append(json.loads(payload.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError) as exc:
+                raise ProtocolError(
+                    f"malformed JSON frame payload ({n} bytes): {exc}"
+                ) from exc
+        return out
+
+
+def send_frame(sock: socket.socket, obj, *,
+               max_bytes: int = MAX_FRAME_BYTES,
+               timeout_s: float = 30.0) -> None:
+    """Write one frame, handling nonblocking sockets: on a full send
+    buffer, wait for writability (up to ``timeout_s``) and continue.
+    Raises ``ProtocolError`` on timeout, ``OSError`` on a dead peer."""
+    data = memoryview(encode_frame(obj, max_bytes=max_bytes))
+    while data:
+        try:
+            sent = sock.send(data)
+        except (BlockingIOError, InterruptedError):
+            sent = 0
+        if not sent:
+            _, writable, _ = select.select([], [sock], [], timeout_s)
+            if not writable:
+                raise ProtocolError(
+                    f"send_frame stalled > {timeout_s}s — peer not "
+                    "draining its socket"
+                )
+            continue
+        data = data[sent:]
+
+
+def recv_available(sock: socket.socket, decoder: FrameDecoder,
+                   *, chunk: int = 65536) -> list | None:
+    """Drain whatever is readable RIGHT NOW into ``decoder`` and return
+    the completed frames; ``None`` means the peer closed the stream at a
+    frame boundary (clean EOF). Never blocks: a would-block read returns
+    the frames completed so far. EOF mid-frame is a
+    :class:`ProtocolError` — the peer died between length word and
+    payload."""
+    frames: list = []
+    while True:
+        try:
+            data = sock.recv(chunk)
+        except (BlockingIOError, InterruptedError):
+            return frames
+        except socket.timeout:
+            return frames
+        except ConnectionResetError:
+            # A peer that closed with unread data in its receive buffer
+            # sends RST, not FIN — same meaning here: it is gone.
+            data = b""
+        if not data:
+            if decoder.buffered:
+                raise ProtocolError(
+                    f"stream closed mid-frame with {decoder.buffered} "
+                    "bytes buffered"
+                )
+            return frames if frames else None
+        frames.extend(decoder.feed(data))
+        if len(data) < chunk:
+            return frames
+
+
+def recv_frames_blocking(sock: socket.socket, decoder: FrameDecoder,
+                         *, timeout_s: float = 30.0) -> list:
+    """Block until AT LEAST one complete frame is available and return
+    everything decoded so far (a peer may batch frames — e.g. a hello
+    immediately followed by a first heartbeat). Raises
+    ``ProtocolError`` on EOF or timeout."""
+    deadline_left = timeout_s
+    while True:
+        frames = recv_available(sock, decoder)
+        if frames is None:
+            raise ProtocolError("stream closed before a complete frame")
+        if frames:
+            return frames
+        readable, _, _ = select.select([sock], [], [], min(deadline_left,
+                                                           0.25))
+        if not readable:
+            deadline_left -= 0.25
+            if deadline_left <= 0:
+                raise ProtocolError(
+                    f"no frame within {timeout_s}s — peer silent"
+                )
+
+
+def digests_to_wire(digests: list[bytes]) -> list[str]:
+    """Chain digests (16-byte blake2b) as hex strings for a JSON frame."""
+    return [d.hex() for d in digests]
+
+
+def digests_from_wire(hexes: list[str]) -> list[bytes]:
+    try:
+        return [bytes.fromhex(h) for h in hexes]
+    except ValueError as exc:
+        raise ProtocolError(f"malformed digest hex: {exc}") from exc

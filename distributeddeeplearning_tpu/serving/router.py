@@ -63,11 +63,13 @@ merges N training processes — no new aggregation code.
 from __future__ import annotations
 
 import dataclasses
+import select
 import time
 
 from ..metrics import event_record, serving_event
 from ..telemetry import NULL_TELEMETRY, Telemetry
 from .engine import ROUTER_POLICIES, SHED_POLICIES, ServingEngine
+from . import net
 from .scheduler import Request, RequestState, chain_digests
 
 
@@ -82,9 +84,20 @@ class RequestShed(RuntimeError):
         self.record = record
 
 
+class StaleHeartbeat(RuntimeError):
+    """A socket replica missed ``serving.heartbeat_timeout_s`` of
+    heartbeats — the router quarantines it exactly like a step fault."""
+
+
 @dataclasses.dataclass
 class Replica:
-    """One engine behind the router, plus its membership state."""
+    """One engine behind the router, plus its membership state.
+
+    This class doubles as the router's TRANSPORT INTERFACE: every method
+    below is what the dispatch / shed / drain / quarantine code paths
+    call, and :class:`SocketReplica` implements the same surface over a
+    worker process's socket — the policy logic never forks on transport.
+    """
 
     index: int
     engine: ServingEngine
@@ -97,6 +110,414 @@ class Replica:
     def live(self) -> bool:
         """Eligible for NEW work (still stepped while draining)."""
         return not (self.draining or self.quarantined)
+
+    # -- transport surface (duck-typed; SocketReplica mirrors it) --------
+
+    #: In-process probes are live, so heartbeat staleness never applies.
+    heartbeat_expected = False
+    last_heartbeat_s = 0.0
+
+    @property
+    def block_size(self) -> int:
+        return self.engine.block_size
+
+    @property
+    def slots_n(self) -> int:
+        return self.engine.slots_n
+
+    @property
+    def num_compiles(self) -> int:
+        return self.engine.num_compiles
+
+    @property
+    def engine_idle(self) -> bool:
+        return self.engine.scheduler.idle
+
+    def load_gauges(self, now: float) -> dict:
+        """Dispatch-time load signals — pulled FRESH from the scheduler
+        (the in-process luxury the socket transport approximates with
+        pushed heartbeats + its own submit ledger)."""
+        return self.engine.scheduler.gauges(now)
+
+    def match_digests(self, digests: list[bytes]) -> int:
+        return self.engine.prefix_match_digests(digests)
+
+    def estimate_parts(self, now: float,
+                       percentile: float) -> tuple[float, float, int]:
+        """(queue_wait_floor, prefill_estimate, pending) for the shed
+        feasibility formula in ``ReplicaRouter._admit_estimate``."""
+        g = self.engine.scheduler.gauges(now)
+        hists = self.telemetry.hists
+
+        def pct(name: str) -> float:
+            h = hists.get(name)
+            if h is None or not h.count:
+                return 0.0
+            return h.percentile(percentile) or 0.0
+
+        queue_wait = max(
+            pct("queue_wait"), float(g.get("oldest_queued_age_s") or 0.0)
+        )
+        return queue_wait, pct("prefill"), g["pending"]
+
+    def submit_request(self, request: Request,
+                       arrival_s: float) -> RequestState:
+        return self.engine.submit(request, arrival_s)
+
+    def reroute_in(self, request: Request, arrival_s: float) -> None:
+        # Straight into the scheduler, bypassing the draining check the
+        # front door applies: rerouted work was ALREADY accepted.
+        self.engine.scheduler.submit(request, arrival_s)
+
+    def step(self) -> bool:
+        return self.engine.step()
+
+    def start_drain(self) -> None:
+        self.engine.drain()
+
+    def take_queued(self) -> list[tuple[Request, float]]:
+        """Pop every queued (never-admitted) request for rerouting."""
+        sched = self.engine.scheduler
+        queued = [(st.request, st.arrival_s) for st in sched.pending]
+        sched.pending.clear()
+        return queued
+
+    def lost_inflight(self) -> list[RequestState]:
+        """Mark in-flight requests lost (their KV died with the replica)
+        and return their states."""
+        out = []
+        for state in self.engine.scheduler.active:
+            state.dropped = True
+            out.append(state)
+        return out
+
+    def finished_states(self) -> list[RequestState]:
+        return self.engine.scheduler.finished
+
+    def stats_snapshot(self) -> dict:
+        return self.engine.stats()
+
+    def do_warmup(self) -> None:
+        self.engine.warmup()
+
+    def set_engine_clock(self, clock) -> None:
+        self.engine.clock = clock
+
+    def close(self) -> None:
+        pass
+
+
+class SocketReplica:
+    """One fleet worker process behind the router, spoken to over the
+    length-prefixed-JSON protocol (serving/net.py). Same transport
+    surface as :class:`Replica`; the differences are WHERE state lives:
+
+    - load gauges come from the worker's last pushed heartbeat, overlaid
+      with this side's own submit ledger (``pending``/``active`` derived
+      from submit/admitted/result frames, which are fresher than any
+      heartbeat cadence);
+    - the prefix probe walks the heartbeat's digest-summary SET — zero
+      cross-process round trips on the submit path;
+    - ``step()`` pumps the socket instead of stepping an engine (the
+      worker steps itself, on its own core — that is the whole point).
+
+    Any socket/protocol fault raises out of ``step()`` and the shared
+    quarantine path handles it like an engine fault.
+    """
+
+    heartbeat_expected = True
+
+    def __init__(self, index: int, sock, hello: dict, *,
+                 clock=time.monotonic, telemetry=NULL_TELEMETRY,
+                 decoder=None, backlog=()):
+        self.index = int(index)
+        self.sock = sock
+        self.telemetry = telemetry
+        self.draining = False
+        self.quarantined = False
+        self.error: str | None = None
+        self.engine = None  # no in-process engine behind this handle
+        self._clock = clock
+        # The handshake's decoder carries over so bytes read past the
+        # hello frame are not lost.
+        self._decoder = decoder if decoder is not None else (
+            net.FrameDecoder()
+        )
+        self.hello = dict(hello)
+        self.block_size = int(hello["block_size"])
+        self.slots_n = int(hello["slots"])
+        self.num_compiles = int(hello.get("num_compiles", 0))
+        self.worker_pid = hello.get("pid")
+        # Pushed state (heartbeats).
+        self.last_heartbeat_s = clock()
+        self.heartbeat_seq = -1
+        self.hb_gauges: dict = {}
+        self.hb_stats: dict = {}
+        self._digests: frozenset[bytes] = frozenset()
+        self._est_queue_wait_s = 0.0
+        self._est_prefill_s = 0.0
+        # Submit ledger: request_id -> (Request, arrival_s). A request
+        # leaves ``_queued`` on the worker's ``admitted`` frame and the
+        # whole ledger on its ``result`` frame.
+        self._outstanding: dict[int, tuple[Request, float]] = {}
+        self._queued: set[int] = set()
+        self._results: dict[int, RequestState] = {}
+        self._stream: dict[int, list[int]] = {}
+        self.goodbye: dict | None = None
+        for msg in backlog:
+            # Frames the handshake read past the hello (e.g. the first
+            # heartbeat) fold in before any dispatch.
+            self._handle(msg)
+
+    @property
+    def live(self) -> bool:
+        return not (self.draining or self.quarantined)
+
+    @property
+    def engine_idle(self) -> bool:
+        return not self._outstanding
+
+    def load_gauges(self, now: float) -> dict:
+        """Heartbeat gauges overlaid with the submit ledger: queue depth
+        and busy lanes the router can compute EXACTLY from its own
+        submit/admitted/result frames (no heartbeat staleness on the
+        signals that matter most), pool occupancy at heartbeat cadence
+        (only the worker knows its block pool)."""
+        g = dict(self.hb_gauges)
+        g["pending"] = len(self._queued)
+        g["active"] = min(
+            len(self._outstanding) - len(self._queued), self.slots_n
+        )
+        g.setdefault("free_blocks", 0)
+        g.setdefault("used_blocks", 0)
+        if self._queued:
+            oldest = min(
+                self._outstanding[rid][1] for rid in self._queued
+            )
+            g["oldest_queued_age_s"] = max(0.0, now - oldest)
+        else:
+            g["oldest_queued_age_s"] = 0.0
+        return g
+
+    def match_digests(self, digests: list[bytes]) -> int:
+        """Leading-run membership in the pushed digest summary. A chain
+        digest names its whole prefix, so a flat set reproduces the
+        worker trie's ``match_digests`` (modulo heartbeat staleness —
+        documented in docs/SERVING.md)."""
+        n = 0
+        for d in digests:
+            if d not in self._digests:
+                break
+            n += 1
+        return n * self.block_size
+
+    def estimate_parts(self, now: float,
+                       percentile: float) -> tuple[float, float, int]:
+        g = self.load_gauges(now)
+        queue_wait = max(
+            self._est_queue_wait_s,
+            float(g.get("oldest_queued_age_s") or 0.0),
+        )
+        return queue_wait, self._est_prefill_s, g["pending"]
+
+    def submit_request(self, request: Request,
+                       arrival_s: float) -> RequestState:
+        rid = int(request.request_id)
+        net.send_frame(self.sock, {
+            "op": "submit",
+            "arrival_s": arrival_s,
+            "request": _request_to_wire(request),
+        })
+        self._outstanding[rid] = (request, arrival_s)
+        self._queued.add(rid)
+        # Placeholder state (the authoritative one lives worker-side and
+        # comes back in the result frame).
+        return RequestState(request=request, arrival_s=arrival_s)
+
+    def reroute_in(self, request: Request, arrival_s: float) -> None:
+        self.submit_request(request, arrival_s)
+
+    def step(self) -> bool:
+        """Pump the socket: drain readable frames, fold pushed state in.
+        Raises on EOF/protocol fault → shared quarantine path."""
+        frames = net.recv_available(self.sock, self._decoder)
+        if frames is None:
+            if self._outstanding:
+                raise net.ProtocolError(
+                    f"worker {self.index} closed its socket with "
+                    f"{len(self._outstanding)} requests outstanding"
+                )
+            return False
+        for msg in frames:
+            self._handle(msg)
+        return bool(self._outstanding)
+
+    def _handle(self, msg: dict) -> None:
+        kind = msg.get("type")
+        if kind == "heartbeat":
+            self.last_heartbeat_s = self._clock()
+            self.heartbeat_seq = int(msg.get("seq", -1))
+            self.hb_gauges = dict(msg.get("gauges") or {})
+            self.hb_stats = dict(msg.get("stats") or {})
+            self.num_compiles = int(
+                msg.get("num_compiles", self.num_compiles)
+            )
+            self._digests = frozenset(
+                net.digests_from_wire(msg.get("digests") or [])
+            )
+            self._est_queue_wait_s = float(msg.get("est_queue_wait_s", 0.0))
+            self._est_prefill_s = float(msg.get("est_prefill_s", 0.0))
+            net.send_frame(self.sock, {
+                "op": "heartbeat_ack", "seq": self.heartbeat_seq,
+            })
+        elif kind == "admitted":
+            self._queued.discard(int(msg["request_id"]))
+        elif kind == "token_delta":
+            self._stream.setdefault(
+                int(msg["request_id"]), []
+            ).extend(int(t) for t in msg.get("tokens", ()))
+        elif kind == "result":
+            rid = int(msg["request_id"])
+            entry = self._outstanding.pop(rid, None)
+            self._queued.discard(rid)
+            if entry is not None:
+                self._results[rid] = _state_from_wire(
+                    entry[0], msg["state"]
+                )
+        elif kind == "submit_error":
+            rid = int(msg["request_id"])
+            self._outstanding.pop(rid, None)
+            self._queued.discard(rid)
+            raise net.ProtocolError(
+                f"worker {self.index} rejected request {rid}: "
+                f"{msg.get('error')}"
+            )
+        elif kind == "goodbye":
+            self.goodbye = msg
+        # drained / poll_reply / hello acks need no folding here.
+
+    def take_queued(self) -> list[tuple[Request, float]]:
+        out = []
+        for rid in sorted(self._queued):
+            out.append(self._outstanding.pop(rid))
+        self._queued.clear()
+        return out
+
+    def lost_inflight(self) -> list[RequestState]:
+        # Admitted-only: ids still in ``_queued`` never took a lane on
+        # the worker, so they stay in the ledger for take_queued() to
+        # re-route — same split the in-process Replica makes between
+        # scheduler.active and scheduler.pending.
+        out = []
+        for rid in sorted(self._outstanding):
+            if rid in self._queued:
+                continue
+            request, arrival_s = self._outstanding[rid]
+            state = RequestState(request=request, arrival_s=arrival_s)
+            state.dropped = True
+            out.append(state)
+        for state in out:
+            del self._outstanding[state.request.request_id]
+        return out
+
+    def finished_states(self) -> list[RequestState]:
+        # Deadline-dropped results resolve the ledger (the worker pushes
+        # them so the fleet reads idle) but are NOT finished work — same
+        # split the in-process scheduler keeps between finished/dropped.
+        return [self._results[k] for k in sorted(self._results)
+                if not self._results[k].dropped]
+
+    @property
+    def dropped_count(self) -> int:
+        return sum(1 for s in self._results.values() if s.dropped)
+
+    def stats_snapshot(self) -> dict:
+        return {
+            "transport": "socket",
+            "num_compiles": self.num_compiles,
+            "heartbeat_seq": self.heartbeat_seq,
+            "dropped": self.dropped_count,
+            **self.hb_stats,
+        }
+
+    def do_warmup(self) -> None:
+        pass  # workers AOT-compile before reporting worker_ready
+
+    def set_engine_clock(self, clock) -> None:
+        pass  # the worker's clock is its own
+
+    def send_op(self, op: str, **fields) -> None:
+        net.send_frame(self.sock, {"op": op, **fields})
+
+    def start_drain(self) -> None:
+        self.send_op("drain")
+
+    def shutdown(self) -> None:
+        try:
+            self.send_op("shutdown")
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _request_to_wire(request: Request) -> dict:
+    return {
+        "prompt": [int(t) for t in request.prompt],
+        "max_new_tokens": int(request.max_new_tokens),
+        "temperature": float(request.temperature),
+        "top_k": int(request.top_k),
+        "top_p": float(request.top_p),
+        "request_id": request.request_id,
+        "deadline_s": request.deadline_s,
+    }
+
+
+def request_from_wire(d: dict) -> Request:
+    return Request(
+        prompt=[int(t) for t in d["prompt"]],
+        max_new_tokens=int(d["max_new_tokens"]),
+        temperature=float(d.get("temperature", 0.0)),
+        top_k=int(d.get("top_k", 0)),
+        top_p=float(d.get("top_p", 0.0)),
+        request_id=d.get("request_id"),
+        deadline_s=d.get("deadline_s"),
+    )
+
+
+def state_to_wire(state: RequestState) -> dict:
+    """The result-frame payload: everything ``RequestState.metrics()``
+    and greedy-parity checks read, nothing device-side."""
+    return {
+        "arrival_s": state.arrival_s,
+        "bucket": state.bucket,
+        "cached_len": state.cached_len,
+        "decode_route": state.decode_route,
+        "generated": [int(t) for t in state.generated],
+        "admit_s": state.admit_s,
+        "first_token_s": state.first_token_s,
+        "finish_s": state.finish_s,
+        "token_times_s": list(state.token_times_s),
+        "dropped": state.dropped,
+    }
+
+
+def _state_from_wire(request: Request, d: dict) -> RequestState:
+    state = RequestState(request=request, arrival_s=float(d["arrival_s"]))
+    state.bucket = int(d.get("bucket", 0))
+    state.cached_len = int(d.get("cached_len", 0))
+    state.decode_route = bool(d.get("decode_route", False))
+    state.generated = [int(t) for t in d.get("generated", ())]
+    state.admit_s = d.get("admit_s")
+    state.first_token_s = d.get("first_token_s")
+    state.finish_s = d.get("finish_s")
+    state.token_times_s = [float(t) for t in d.get("token_times_s", ())]
+    state.dropped = bool(d.get("dropped", False))
+    return state
 
 
 class ReplicaRouter:
@@ -112,8 +533,10 @@ class ReplicaRouter:
 
     def __init__(self, model, params, cfg, *, clock=time.monotonic,
                  seed: int = 0, emit=None, static_batching: bool = False,
-                 telemetry_dir: str | None = None):
-        n = int(getattr(cfg, "replicas", 1))
+                 telemetry_dir: str | None = None, transports=None):
+        n = len(transports) if transports is not None else int(
+            getattr(cfg, "replicas", 1)
+        )
         if n < 1:
             raise ValueError(
                 f"serving.replicas must be >= 1, got {n} — 1 serves "
@@ -160,22 +583,35 @@ class ReplicaRouter:
         self.telemetry_dir = telemetry_dir
         self.events: list[dict] = []
         self._emit = emit if emit is not None else self.events.append
+        self.heartbeat_timeout_s = float(
+            getattr(cfg, "heartbeat_timeout_s", 0.0) or 0.0
+        )
+        # Socket pump idle wait (real-clock fleets only): step() blocks
+        # up to this long on the fleet's sockets when a tick moved
+        # nothing, instead of burning the workers' CPU in a hot poll.
+        self.io_wait_s = 0.002 if clock is time.monotonic else 0.0
         self.replicas: list[Replica] = []
-        for i in range(n):
-            tel = (
-                Telemetry(enabled=True, out_dir=telemetry_dir,
-                          process_index=i)
-                if telemetry_dir is not None else NULL_TELEMETRY
-            )
-            engine = ServingEngine(
-                model, params, cfg, clock=clock, seed=seed, telemetry=tel,
-                # Replica-tagged events into the ROUTER's single ordered
-                # stream — per-engine step counters would interleave
-                # ambiguously without the tag.
-                emit=lambda rec, i=i: self._emit({**rec, "replica": i}),
-            )
-            self.replicas.append(Replica(index=i, engine=engine,
-                                         telemetry=tel))
+        if transports is not None:
+            self.replicas = list(transports)
+        else:
+            for i in range(n):
+                tel = (
+                    Telemetry(enabled=True, out_dir=telemetry_dir,
+                              process_index=i)
+                    if telemetry_dir is not None else NULL_TELEMETRY
+                )
+                engine = ServingEngine(
+                    model, params, cfg, clock=clock, seed=seed,
+                    telemetry=tel,
+                    # Replica-tagged events into the ROUTER's single
+                    # ordered stream — per-engine step counters would
+                    # interleave ambiguously without the tag.
+                    emit=lambda rec, i=i: self._emit(
+                        {**rec, "replica": i}
+                    ),
+                )
+                self.replicas.append(Replica(index=i, engine=engine,
+                                             telemetry=tel))
         # Globally-unique request ids across replicas: each engine's
         # scheduler counts from 0, so the router must number requests
         # BEFORE dispatch or two replicas would mint colliding ids (and
@@ -216,14 +652,16 @@ class ReplicaRouter:
 
         def load(r: Replica):
             if r.index not in loads:
-                g = r.engine.scheduler.gauges(now)
+                g = r.load_gauges(now)
                 loads[r.index] = (
                     g["pending"], g["active"], g["used_blocks"], r.index
                 )
             return loads[r.index]
 
         if self.policy == "prefix_affinity" and request is not None:
-            # Probe every live replica's trie (read-only). The chain
+            # Probe every live replica's trie (read-only; for a socket
+            # replica the probe walks the digest summary its heartbeat
+            # pushed — zero cross-process round trips). The chain
             # digests are hashed ONCE here and handed to every probe, so
             # dispatch costs O(prompt) hashing instead of O(replicas x
             # prompt) — replicas share a block size, so one digest chain
@@ -231,10 +669,10 @@ class ReplicaRouter:
             # least-loaded key tie-breaks, so N replicas holding the same
             # hot prefix still spread its traffic.
             digests = chain_digests(
-                list(request.prompt), live[0].engine.block_size
+                list(request.prompt), live[0].block_size
             )
             matches = [
-                (r.engine.prefix_match_digests(digests), r)
+                (r.match_digests(digests), r)
                 for r in live
             ]
             best = max(m for m, _ in matches)
@@ -247,7 +685,7 @@ class ReplicaRouter:
                 # already a full lane-batch deeper than the idlest
                 # replica's.
                 floor = min(load(r)[0] for r in live)
-                if load(choice)[0] - floor <= choice.engine.slots_n:
+                if load(choice)[0] - floor <= choice.slots_n:
                     return choice
         return min(live, key=load)
 
@@ -265,21 +703,16 @@ class ReplicaRouter:
           signal that fires during a cold-start burst (100x offered
           load lands before any queue-wait sample exists);
         - plus one prefill for the request itself.
+
+        A socket replica supplies the same three parts from its pushed
+        heartbeat (the worker computes its own histogram percentiles)
+        plus the router's submit ledger — the formula does not fork on
+        transport.
         """
-        g = replica.engine.scheduler.gauges(now)
-        hists = replica.telemetry.hists
-
-        def pct(name: str) -> float:
-            h = hists.get(name)
-            if h is None or not h.count:
-                return 0.0
-            return h.percentile(self.shed_percentile) or 0.0
-
-        queue_wait = max(
-            pct("queue_wait"), float(g.get("oldest_queued_age_s") or 0.0)
+        queue_wait, prefill, pending = replica.estimate_parts(
+            now, self.shed_percentile
         )
-        prefill = pct("prefill")
-        return queue_wait + g["pending"] * prefill + prefill
+        return queue_wait + pending * prefill + prefill
 
     def submit(self, request: Request) -> RequestState:
         """Route one request: pick a replica, shed if its deadline is
@@ -314,7 +747,7 @@ class ReplicaRouter:
                 )
         # Arrival stamped with the ROUTER's now: the request arrived when
         # it hit the router, whatever the replica's clock reads.
-        state = replica.engine.submit(request, now)
+        state = replica.submit_request(request, now)
         self.routes[int(request.request_id)] = replica.index
         return state
 
@@ -323,26 +756,59 @@ class ReplicaRouter:
     # ------------------------------------------------------------------
 
     def step_replica(self, index: int) -> bool:
-        """One engine step on one replica, with quarantine-on-raise.
+        """One transport step on one replica (engine step in-process,
+        socket pump for a fleet worker), with quarantine-on-raise.
         Returns False when that replica is idle (or just died)."""
         r = self.replicas[index]
         if r.quarantined:
             return False
         try:
-            return r.engine.step()
+            return r.step()
         except Exception as exc:  # noqa: BLE001 — any step fault kills it
             self._quarantine(r, exc)
             return False
 
     def step(self) -> bool:
         """One router tick: step every non-quarantined replica (draining
-        replicas included — they must finish their in-flight work).
-        Returns False when the whole fleet is idle."""
+        replicas included — they must finish their in-flight work), then
+        sweep for stale heartbeats. Returns False when the whole fleet
+        is idle."""
         self.tick_count += 1
         busy = False
         for r in self.replicas:
             busy = self.step_replica(r.index) or busy
+        self.check_heartbeats()
+        if busy and self.io_wait_s:
+            socks = [
+                r.sock for r in self.replicas
+                if r.heartbeat_expected and not r.quarantined
+            ]
+            if socks:
+                # Real-clock fleet: the workers do the stepping, so wait
+                # on their sockets instead of hot-polling one core out
+                # from under them.
+                select.select(socks, [], [], self.io_wait_s)
         return busy
+
+    def check_heartbeats(self, now: float | None = None) -> None:
+        """Quarantine socket replicas whose last heartbeat is older than
+        ``serving.heartbeat_timeout_s`` (0 = sweep disabled). Runs
+        through the SAME quarantine path as a step fault: in-flight
+        work on the stale worker is reported lost, queued work reroutes
+        to the survivors."""
+        if not self.heartbeat_timeout_s:
+            return
+        now = self.clock() if now is None else now
+        for r in self.replicas:
+            if not r.heartbeat_expected or r.quarantined:
+                continue
+            age = now - r.last_heartbeat_s
+            if age > self.heartbeat_timeout_s:
+                self._quarantine(r, StaleHeartbeat(
+                    f"no heartbeat from worker {r.index} for "
+                    f"{age:.3f}s (> heartbeat_timeout_s="
+                    f"{self.heartbeat_timeout_s})"
+                ))
 
     def _quarantine(self, replica: Replica, exc: Exception) -> None:
         replica.quarantined = True
@@ -351,11 +817,9 @@ class ReplicaRouter:
             "replica_quarantined", self.tick_count,
             replica=replica.index, error=replica.error,
         ))
-        sched = replica.engine.scheduler
         # In-flight requests die with the replica: their KV lives in its
         # pool and cannot be recovered. Report each loss, typed.
-        for state in sched.active:
-            state.dropped = True
+        for state in replica.lost_inflight():
             self.failed.append(state)
             self._emit(serving_event(
                 "request_failed", self.tick_count,
@@ -366,23 +830,22 @@ class ReplicaRouter:
         # re-route them through normal dispatch. No shed re-check — the
         # front door already accepted them; if the detour blew their
         # deadline the surviving engine's admit pass drops them there.
-        queued = list(sched.pending)
-        sched.pending.clear()
-        for state in queued:
+        for request, arrival_s in replica.take_queued():
             self.rerouted += 1
             self._emit(serving_event(
                 "request_rerouted", self.tick_count,
-                request_id=state.request.request_id,
+                request_id=request.request_id,
                 replica=replica.index, reason="replica_quarantined",
             ))
             # Normal dispatch, affinity included: the dead replica's trie
             # died with it, so the probe only ever sees survivors.
-            target = self._pick(self.clock(), state.request)
+            target = self._pick(self.clock(), request)
             # Straight into the target's scheduler with the ORIGINAL
             # arrival time: the detour's queueing is real latency the
             # request experienced and must stay in its TTFT.
-            target.engine.scheduler.submit(state.request, state.arrival_s)
-            self.routes[int(state.request.request_id)] = target.index
+            target.reroute_in(request, arrival_s)
+            self.routes[int(request.request_id)] = target.index
+        replica.close()
 
     # ------------------------------------------------------------------
     # membership
@@ -394,7 +857,7 @@ class ReplicaRouter:
         and once idle its pool is back to the empty-engine state."""
         r = self.replicas[index]
         r.draining = True
-        r.engine.drain()
+        r.start_drain()
         self._emit(event_record(
             "replica_draining", self.tick_count, replica=index,
         ))
@@ -409,16 +872,16 @@ class ReplicaRouter:
         1)`` executables, ``+ 2`` per replica with speculation on — and
         ZERO more in steady state."""
         for r in self.replicas:
-            r.engine.warmup()
+            r.do_warmup()
 
     @property
     def num_compiles(self) -> int:
-        return sum(r.engine.num_compiles for r in self.replicas)
+        return sum(r.num_compiles for r in self.replicas)
 
     @property
     def idle(self) -> bool:
         return all(
-            r.quarantined or r.engine.scheduler.idle for r in self.replicas
+            r.quarantined or r.engine_idle for r in self.replicas
         )
 
     def run(self, max_steps: int = 0) -> list[RequestState]:
@@ -436,7 +899,7 @@ class ReplicaRouter:
         for r in self.replicas:
             # A quarantined replica's COMPLETED requests were delivered
             # before it died — they count.
-            out.extend(r.engine.scheduler.finished)
+            out.extend(r.finished_states())
         return sorted(out, key=lambda s: s.request.request_id)
 
     def gauges(self) -> list[dict]:
@@ -446,7 +909,7 @@ class ReplicaRouter:
             {"replica": r.index, "draining": r.draining,
              "quarantined": r.quarantined,
              **(({} if r.quarantined
-                 else r.engine.scheduler.gauges(now)))}
+                 else r.load_gauges(now)))}
             for r in self.replicas
         ]
 
@@ -468,7 +931,7 @@ class ReplicaRouter:
             "ticks": self.tick_count,
             "num_compiles": self.num_compiles,
             "per_replica": [
-                {"replica": r.index, **r.engine.stats()}
+                {"replica": r.index, **r.stats_snapshot()}
                 for r in self.replicas
             ],
         }
@@ -488,6 +951,70 @@ class ReplicaRouter:
         virtual-time N-chip simulation in tools/serve_bench.py."""
         self.clock = clock
         for r in self.replicas:
-            r.engine.clock = (
+            r.set_engine_clock(
                 per_replica(r.index) if per_replica is not None else clock
             )
+
+    def shutdown_fleet(self, *, wait_s: float = 5.0) -> None:
+        """Politely stop every socket worker: send the ``shutdown`` op,
+        pump for goodbyes up to ``wait_s``, close the connections.
+        In-process replicas are untouched (nothing to stop)."""
+        socks = [
+            r for r in self.replicas
+            if r.heartbeat_expected and not r.quarantined
+        ]
+        for r in socks:
+            r.shutdown()
+        deadline = time.monotonic() + wait_s
+        while (time.monotonic() < deadline
+               and any(r.goodbye is None for r in socks)):
+            for r in socks:
+                if r.goodbye is None:
+                    try:
+                        r.step()
+                    except Exception:  # noqa: BLE001 — already stopping
+                        r.goodbye = {"type": "goodbye", "lost": True}
+            pending = [r.sock for r in socks if r.goodbye is None]
+            if pending:
+                select.select(pending, [], [], 0.05)
+        for r in socks:
+            r.close()
+
+
+def connect_fleet(cfg, endpoints, *, clock=time.monotonic, emit=None,
+                  connect_timeout_s: float = 60.0) -> ReplicaRouter:
+    """Dial a list of ``(host, port)`` worker endpoints, run the hello
+    handshake on each, and front them with a :class:`ReplicaRouter`
+    whose replicas are :class:`SocketReplica` transports — dispatch,
+    shedding, draining and quarantine all run the exact in-process code
+    paths on pushed state. ``cfg`` is the ``ServingConfig`` the workers
+    were launched with (policy/shed/heartbeat knobs must agree)."""
+    import socket as _socket
+
+    transports = []
+    for i, (host, port) in enumerate(endpoints):
+        sock = _socket.create_connection(
+            (host, int(port)), timeout=connect_timeout_s
+        )
+        sock.setblocking(False)
+        try:
+            decoder = net.FrameDecoder()
+            frames = net.recv_frames_blocking(
+                sock, decoder, timeout_s=connect_timeout_s
+            )
+            hello = frames[0]
+            if hello.get("type") != "hello":
+                raise net.ProtocolError(
+                    f"worker {i} opened with {hello.get('type')!r}, "
+                    "expected 'hello'"
+                )
+        except Exception:
+            sock.close()
+            raise
+        transports.append(
+            SocketReplica(i, sock, hello, clock=clock, decoder=decoder,
+                          backlog=frames[1:])
+        )
+    return ReplicaRouter(
+        None, None, cfg, clock=clock, emit=emit, transports=transports,
+    )

@@ -114,6 +114,10 @@ def test_report_on_repo_root(tmp_path):
         assert sv["kv_tokens_match_spill_off"] is True
         assert sv["kv_int8_adversarial_hit_rate"] == 0.0
         assert 0.0 <= sv["kv_int8_max_rel_drift"] <= 0.05
+        # ... and the socket-fleet wall-clock scale-out headline.
+        assert sv["fleet_wallclock_tps_ratio_4x"] >= 2.5
+        assert sv["fleet_tokens_match_oracle"] is True
+        assert sv["fleet_shed_accounting_exact"] is True
         # ... and the quantized device pool's capacity headline.
         assert sv["kvq_block_capacity_ratio_int8"] >= 2.0
         assert sv["kvq_tokens_match_fp_reference"] is True

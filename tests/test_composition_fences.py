@@ -827,3 +827,63 @@ def test_kv_quant_rejects_static_batching_by_name():
                         kv_quant="int8")
     with pytest.raises(NotImplementedError, match="static_batching"):
         ServingEngine(model, params, cfg, static_batching=True)
+
+
+# ---------------------------------------------------------------------------
+# Socket fleet fence matrix (cli serve --fleet x batching/ports/heartbeats)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fleet,kwargs,extra,err,match", [
+    # fleet size bounds name the flag
+    (0, {}, {}, ValueError, "fleet must be >= 1"),
+    (-3, {}, {}, ValueError, "fleet must be >= 1"),
+    # fleet x static_batching: the static baseline is a ONE-engine
+    # measurement — a socket fleet in front re-mixes admission policy
+    (4, {}, dict(static_batching=True), NotImplementedError,
+     "static_batching"),
+    # endpoint config: bad host/port fail before any process spawns
+    (2, dict(worker_host=""), {}, ValueError, "worker_host"),
+    (2, dict(worker_host="   "), {}, ValueError, "worker_host"),
+    (2, dict(worker_port=-1), {}, ValueError, "worker_port"),
+    (2, dict(worker_port=70000), {}, ValueError, "worker_port"),
+    # worker i binds worker_port + i: the last worker must not overflow
+    (4, dict(worker_port=65534), {}, ValueError, "worker_port"),
+    # heartbeat cadence: the router's policies run on pushed state — a
+    # worker that never heartbeats is permanently stale
+    (2, dict(heartbeat_interval_s=0.0), {}, ValueError,
+     "heartbeat_interval_s"),
+    (2, dict(heartbeat_interval_s=-1.0), {}, ValueError,
+     "heartbeat_interval_s"),
+    # a timeout under one interval quarantines healthy workers
+    (2, dict(heartbeat_interval_s=0.5, heartbeat_timeout_s=0.25), {},
+     ValueError, "heartbeat_timeout_s"),
+])
+def test_fleet_fence_matrix(fleet, kwargs, extra, err, match):
+    from distributeddeeplearning_tpu.config import ServingConfig
+    from distributeddeeplearning_tpu.serving import check_fleet_composition
+
+    cfg = ServingConfig(**kwargs)
+    with pytest.raises(err, match=match):
+        check_fleet_composition(cfg, fleet, **extra)
+
+
+@pytest.mark.parametrize("fleet,kwargs", [
+    (1, {}),
+    (4, dict(worker_port=65532)),  # 65532..65535: exactly fits
+    (2, dict(heartbeat_timeout_s=0.0)),  # 0 = staleness sweep disabled
+    # the capability compositions the fleet must keep serving: affinity
+    # needs the trie, quant and speculation are per-engine features the
+    # transport never sees (parity pinned in tests/test_serving_worker.py
+    # and the serve_bench fleet block)
+    (4, dict(prefix_cache=True, router_policy="prefix_affinity")),
+    (2, dict(kv_quant="int8")),
+    (2, dict(speculation="ngram:3")),
+    (4, dict(prefix_cache=True, router_policy="prefix_affinity",
+             kv_quant="int8", speculation="ngram:3")),
+])
+def test_fleet_legal_compositions_pass(fleet, kwargs):
+    from distributeddeeplearning_tpu.config import ServingConfig
+    from distributeddeeplearning_tpu.serving import check_fleet_composition
+
+    check_fleet_composition(ServingConfig(**kwargs), fleet)  # must not raise

@@ -43,6 +43,15 @@ tight host budget), the exactly-0.0 adversarial hit rate, and the
 unchanged compile pin; the >= 2x hit-token recovery headline is pinned
 on the committed artifact.
 
+PR 18 adds the ``fleet`` block: REAL ``serving.worker`` child processes
+behind sockets, replayed on the wall clock with a per-step dwell as the
+CPU sim's device-latency stand-in. The tier-1 smoke leg SKIPS it
+(DDL_SERVE_FLEET="") — spawning real workers is seconds of warmup each
+and the transport itself is pinned by tests/test_serving_worker.py; a
+``slow`` leg runs a shrunken fleet block through the tool, and the
+committed artifact must clear the >= 2.5x wall-clock scale-out bar with
+oracle parity, per-worker compile pins, and exact overload accounting.
+
 PR 17 adds the ``kv_quant`` block: the device pool itself quantized
 (serving.kv_quant='int8') replaying the standard trace (token parity
 vs the fp continuous row) and the constrained shared-prefix trace with
@@ -301,12 +310,49 @@ def test_serve_bench_smoke(tmp_path):
     # trace): the full router path — dispatch, virtual clocks, shedding,
     # parity oracle, fleet compile pin — without the committed sweep's
     # 9-cell cost.
+    # Fleet block skipped (DDL_SERVE_FLEET=""): real worker processes
+    # cost seconds of warmup each; the socket transport is pinned by
+    # tests/test_serving_worker.py and the slow leg below.
     rec = _run_bench(tmp_path, DDL_SERVE_N="6", DDL_SERVE_RATE="100",
                      DDL_SERVE_SEED="0", DDL_SERVE_REPLICAS="1,2",
-                     DDL_SERVE_LOADS="10", DDL_SERVE_ROUTER_N="8")
+                     DDL_SERVE_LOADS="10", DDL_SERVE_ROUTER_N="8",
+                     DDL_SERVE_FLEET="")
     _check_shape(rec, 6)
     assert rec["router"]["replicas_swept"] == [1, 2]
     assert all(r["requests"] == 8 for r in rec["router"]["rows"])
+    assert rec["fleet"] is None
+
+
+@pytest.mark.slow
+def test_serve_bench_fleet_smoke(tmp_path):
+    # A shrunken fleet block through the real tool path: 1 and 2 actual
+    # worker subprocesses, the oracle subprocess, wall-clock replay.
+    # RATIOS are not asserted (2 workers, 6 requests: noise) — parity,
+    # compile pins, accounting, and clean exits are.
+    rec = _run_bench(tmp_path, DDL_SERVE_N="6", DDL_SERVE_RATE="100",
+                     DDL_SERVE_SEED="0", DDL_SERVE_REPLICAS="1",
+                     DDL_SERVE_LOADS="10", DDL_SERVE_ROUTER_N="8",
+                     DDL_SERVE_FLEET="1,2", DDL_SERVE_FLEET_N="6",
+                     DDL_SERVE_DWELL="0.01")
+    flt = rec["fleet"]
+    assert flt["workers_swept"] == [1, 2]
+    assert "wall clock" in flt["timebase"]
+    assert flt["dwell_s"] == 0.01
+    for row in flt["rows"]:
+        assert row["transport"] == "socket"
+        assert row["tokens_match_oracle"] is True
+        assert (row["compiles_after_run"] == row["compiles_at_ready"]
+                == [row["compile_pin_per_worker"]] * row["workers"])
+        assert row["worker_exit_codes"] == [0] * row["workers"]
+    shed = flt["shed_row"]
+    assert (shed["served"] + shed["shed"] + shed["dropped_in_queue"]
+            == shed["requests"])
+    comp = flt["comparison"]
+    assert comp["tokens_match_oracle"] is True
+    assert comp["zero_recompiles_per_worker"] is True
+    assert comp["shed_accounting_exact"] is True
+    # The 2-worker row carries the merged-telemetry check.
+    assert comp["fleet_merge_processes"] == [0, 1]
 
 
 @pytest.mark.slow
@@ -352,6 +398,17 @@ def test_bench_serving_artifact():
     assert rcomp["tokens_match_reference"] is True
     assert rcomp["zero_recompiles_per_replica"] is True
     assert rcomp["p99_ttft_bounded_under_shedding"] is True
+    # Socket-fleet headline (real worker processes, wall clock): >= 2.5x
+    # tokens/s at 4 workers over 1 at saturating load, exact greedy
+    # parity vs the direct single-engine oracle, per-worker compile pins
+    # unchanged over the wire, and exact overload accounting.
+    fc = rec["fleet"]["comparison"]
+    assert fc["wallclock_tps_ratio_4x"] >= 2.5
+    assert fc["tokens_match_oracle"] is True
+    assert fc["zero_recompiles_per_worker"] is True
+    assert fc["shed_accounting_exact"] is True
+    assert fc["fleet_merge_processes"] == [0, 1, 2, 3]
+    assert fc["workers_exit_zero"] is True
     # Prefix-cache headline (the full-load shared-prefix trace): the
     # trie must remove at least half the prefill tokens and the warm
     # engine's median first token must arrive sooner, at a hit rate that

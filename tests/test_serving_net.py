@@ -1,0 +1,111 @@
+"""Wire framing (serving/net.py): partial reads, oversized-frame
+rejection by name, malformed payloads as typed ProtocolError, and
+round-trip fuzz — all on plain byte buffers, no sockets."""
+
+import random
+
+import pytest
+
+from distributeddeeplearning_tpu.serving.net import (
+    MAX_FRAME_BYTES,
+    FrameDecoder,
+    ProtocolError,
+    digests_from_wire,
+    digests_to_wire,
+    encode_frame,
+)
+
+
+def test_round_trip_single_frame():
+    obj = {"type": "heartbeat", "seq": 3, "gauges": {"pending": 2},
+           "digests": ["ab" * 16], "t_s": 1.5, "none": None}
+    (out,) = FrameDecoder().feed(encode_frame(obj))
+    assert out == obj
+
+
+def test_partial_reads_byte_by_byte():
+    # A nonblocking recv loop can hand the decoder ANY split — including
+    # one byte at a time, splitting the length word itself. Frames must
+    # only surface once complete, then decode identically.
+    objs = [{"op": "submit", "request": {"prompt": [1, 2, 3]}},
+            {"type": "admitted", "request_id": 7},
+            {"k": "x" * 300}]
+    wire = b"".join(encode_frame(o) for o in objs)
+    dec = FrameDecoder()
+    got = []
+    for i in range(len(wire)):
+        frames = dec.feed(wire[i:i + 1])
+        if i < len(wire) - 1 and dec.buffered:
+            assert len(frames) <= 1
+        got.extend(frames)
+    assert got == objs
+    assert dec.buffered == 0
+
+
+def test_multiple_frames_in_one_chunk():
+    objs = [{"i": i} for i in range(5)]
+    wire = b"".join(encode_frame(o) for o in objs)
+    assert FrameDecoder().feed(wire) == objs
+
+
+def test_oversized_encode_rejected_by_name():
+    with pytest.raises(ProtocolError, match="max_bytes"):
+        encode_frame({"blob": "x" * 128}, max_bytes=64)
+    # The default cap is generous but real.
+    assert len(encode_frame({"ok": 1})) < MAX_FRAME_BYTES
+
+
+def test_oversized_declared_length_rejected_before_buffering():
+    # A corrupt (or hostile) length word must be rejected from the 4-byte
+    # prefix alone — BEFORE any payload is buffered, so a bad peer cannot
+    # OOM the decoder by declaring a huge frame.
+    dec = FrameDecoder(max_bytes=1024)
+    header = (2048).to_bytes(4, "big")
+    with pytest.raises(ProtocolError, match="max_bytes"):
+        dec.feed(header)
+    assert dec.buffered <= 4  # nothing beyond the prefix was kept
+
+
+def test_malformed_json_payload_is_protocol_error():
+    payload = b"{not json!"
+    wire = len(payload).to_bytes(4, "big") + payload
+    with pytest.raises(ProtocolError, match="malformed JSON"):
+        FrameDecoder().feed(wire)
+
+
+def test_invalid_utf8_payload_is_protocol_error():
+    payload = b"\xff\xfe\x00\x01"
+    wire = len(payload).to_bytes(4, "big") + payload
+    with pytest.raises(ProtocolError, match="malformed JSON"):
+        FrameDecoder().feed(wire)
+
+
+def test_round_trip_fuzz_random_sizes_and_splits():
+    # Seeded fuzz: frames of wildly varying payload size, concatenated
+    # and re-chunked at random boundaries, must decode back exactly and
+    # in order. This is the shape a real TCP stream produces.
+    rng = random.Random(0xF1EE7)
+    objs = []
+    for i in range(40):
+        n = rng.choice([0, 1, 7, 63, 257, 1024, 5000])
+        objs.append({
+            "i": i,
+            "payload": "".join(rng.choice("abcdef") for _ in range(n)),
+            "nums": [rng.randrange(256) for _ in range(rng.randrange(9))],
+        })
+    wire = b"".join(encode_frame(o) for o in objs)
+    dec = FrameDecoder()
+    got, pos = [], 0
+    while pos < len(wire):
+        step = rng.randrange(1, 700)
+        got.extend(dec.feed(wire[pos:pos + step]))
+        pos += step
+    assert got == objs
+    assert dec.buffered == 0
+
+
+def test_digest_hex_codec_round_trip():
+    digests = [bytes(range(16)), b"\x00" * 16, b"\xff" * 16]
+    assert digests_from_wire(digests_to_wire(digests)) == digests
+    with pytest.raises(ProtocolError, match="digest hex"):
+        digests_from_wire(["zz"])

@@ -1,0 +1,514 @@
+"""Fleet worker + socket transport (serving/worker.py, SocketReplica):
+cross-process parity over socketpairs, pushed heartbeats/digests, the
+SIGTERM preemption contract, stale-heartbeat quarantine + reroute, the
+op surface (poll/drain/shutdown), and the cli fleet-plan helpers — all
+fake-clock deterministic, no subprocesses except the slow e2e."""
+
+import io
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.cli import _fleet_plan, read_worker_ready
+from distributeddeeplearning_tpu.config import ServingConfig
+from distributeddeeplearning_tpu.serving import (
+    Request,
+    ReplicaRouter,
+    ServingEngine,
+    SocketReplica,
+    chain_digests,
+)
+from distributeddeeplearning_tpu.serving import net
+from distributeddeeplearning_tpu.serving.worker import ReplicaWorker
+from distributeddeeplearning_tpu.supervisor import EXIT_PREEMPTED
+from distributeddeeplearning_tpu.telemetry import NULL_TELEMETRY
+
+_CFG = ServingConfig(
+    slots=3, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+    prompt_buckets=(8, 16), heartbeat_interval_s=0.5,
+    heartbeat_timeout_s=2.0,
+)
+
+
+def _model_and_params(seed=7):
+    model = models.get_model("gpt2", size="tiny", vocab_size=97, max_len=64)
+    params = model.init(
+        jax.random.PRNGKey(seed), np.zeros((1, 8), np.int32)
+    )["params"]
+    return model, params
+
+
+def _prompts(lens, seed=42):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(1, 97, n))) for n in lens]
+
+
+def _cell_clock(t0=100.0):
+    t = [t0]
+    return t, (lambda: t[0])
+
+
+def _reference(model, params, prompts, max_new=9):
+    eng = ServingEngine(model, params, ServingConfig(**{
+        **vars(_CFG), "heartbeat_interval_s": 0.05,
+        "heartbeat_timeout_s": 0.0,
+    }))
+    for j, p in enumerate(prompts):
+        eng.submit(Request(prompt=list(p), max_new_tokens=max_new,
+                           request_id=j))
+    return {s.request.request_id: list(s.generated) for s in eng.run()}
+
+
+def _fleet(n, cfg, clock, *, model=None, params=None, telemetries=None):
+    """n ReplicaWorkers over socketpairs + a router of SocketReplica
+    transports — the whole cross-process stack, in-process, on a fake
+    clock. Returns (workers, router)."""
+    if model is None:
+        model, params = _model_and_params()
+    workers, transports = [], []
+    for i in range(n):
+        router_side, worker_side = socket.socketpair()
+        router_side.setblocking(False)
+        worker_side.setblocking(False)
+        tel = telemetries[i] if telemetries else None
+        engine = ServingEngine(model, params, cfg, clock=clock,
+                               telemetry=tel)
+        engine.warmup()  # real workers AOT-warm before worker_ready
+        w = ReplicaWorker(
+            engine, worker_side, replica_index=i, clock=clock,
+            sleep=lambda s: None,
+            heartbeat_interval_s=cfg.heartbeat_interval_s,
+            telemetry=tel if tel is not None else NULL_TELEMETRY,
+        )
+        w.start()
+        dec = net.FrameDecoder()
+        frames = net.recv_available(router_side, dec) or []
+        assert frames and frames[0]["type"] == "hello"
+        transports.append(SocketReplica(
+            i, router_side, frames[0], clock=clock, decoder=dec,
+            backlog=frames[1:],
+        ))
+        workers.append(w)
+    router = ReplicaRouter(None, None, cfg, clock=clock,
+                           transports=transports)
+    return workers, router
+
+
+def _drive(router, workers, t, *, dt=0.01, pump=None, max_iters=5000):
+    """Tick the fleet to idle: advance the fake clock, pump every live
+    worker (or the ``pump`` subset), step the router."""
+    for _ in range(max_iters):
+        if router.idle:
+            return router.finished()
+        t[0] += dt
+        for w in (pump if pump is not None else workers):
+            if w.exit_code is None:
+                w.pump()
+        router.step()
+    raise AssertionError("fleet never drained idle")
+
+
+# ---------------------------------------------------------------------------
+# Parity: socket transport must not change a single token
+# ---------------------------------------------------------------------------
+
+
+def test_socket_fleet_greedy_parity_and_compile_pin():
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9, 3, 12, 7, 4))
+    ref = _reference(model, params, prompts)
+    t, clock = _cell_clock()
+    workers, router = _fleet(2, _CFG, clock, model=model, params=params)
+    for p in prompts:
+        router.submit(Request(prompt=list(p), max_new_tokens=9))
+    done = _drive(router, workers, t)
+    assert len(done) == len(prompts)
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    assert sorted(set(router.routes.values())) == [0, 1]
+    # Per-worker compile pin over the wire: each engine compiled one
+    # prefill per bucket + decode, serving added zero, and the heartbeat
+    # propagated the exact count to the router's aggregate.
+    pin = len(_CFG.prompt_buckets) + 1
+    assert all(w.engine.num_compiles == pin for w in workers)
+    assert router.num_compiles == 2 * pin
+    # Results carry real per-request metrics (reconstructed from the
+    # wire), not placeholders.
+    for s in done:
+        m = s.metrics()
+        assert m["new_tokens"] == 9
+        assert m["e2e_s"] >= 0.0
+
+
+def test_heartbeat_pushes_gauges_digests_and_acks_round_trip():
+    cfg = ServingConfig(**{
+        **vars(_CFG), "prefix_cache": True, "suffix_buckets": (4,),
+    })
+    model, params = _model_and_params()
+    t, clock = _cell_clock()
+    workers, router = _fleet(1, cfg, clock, model=model, params=params)
+    (w,), (sr,) = workers, router.replicas
+    seq0 = sr.heartbeat_seq
+    assert seq0 >= 1  # the handshake backlog carried the first heartbeat
+    prompt = _prompts((12,))[0]
+    router.submit(Request(prompt=list(prompt), max_new_tokens=6))
+    _drive(router, workers, t)
+    # Next interval's heartbeat carries the warmed trie's digest summary;
+    # the router-side probe must see the cached prefix WITHOUT any
+    # cross-process round trip.
+    t[0] += cfg.heartbeat_interval_s + 0.01
+    w.pump()
+    router.step()
+    assert sr.heartbeat_seq > seq0
+    assert sr._digests
+    probe = chain_digests(prompt + [1, 2, 3], cfg.block_size)
+    assert sr.match_digests(probe) > 0
+    g = sr.load_gauges(t[0])
+    assert g["pending"] == 0 and g["active"] == 0
+    assert g["used_blocks"] == 0  # trie blocks are cached, not leased
+    # The ack made it back: the worker saw a receipt for a recent seq.
+    w.pump()
+    assert w.last_ack_seq >= seq0
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM: drain in-flight, push results, flush artifacts, exit preempted
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_drains_pushes_results_and_exits_preempted(tmp_path):
+    from distributeddeeplearning_tpu.telemetry import Telemetry
+
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9))
+    ref = _reference(model, params, prompts)
+    t, clock = _cell_clock()
+    tel = Telemetry(enabled=True, out_dir=str(tmp_path), process_index=0)
+    workers, router = _fleet(1, _CFG, clock, model=model, params=params,
+                             telemetries=[tel])
+    (w,), (sr,) = workers, router.replicas
+    for p in prompts:
+        router.submit(Request(prompt=list(p), max_new_tokens=9))
+    w.pump()
+    router.step()  # work genuinely in flight when the signal lands
+    w.on_sigterm()
+    assert w.engine.draining
+    for _ in range(2000):
+        if w.exit_code is not None and router.idle:
+            break
+        t[0] += 0.01
+        w.pump()
+        router.step()
+    # The preemption contract, end to end on a fake clock: accepted work
+    # finished token-identically, the exit code is the supervisor's
+    # clean-preemption code, and the goodbye frame reported it.
+    assert w.exit_code == EXIT_PREEMPTED
+    done = router.finished()
+    assert len(done) == 2
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    assert sr.goodbye is not None and sr.goodbye["exit"] == EXIT_PREEMPTED
+    # Telemetry flushed on the way out: stamped artifacts exist on disk.
+    stamped = os.listdir(tmp_path)
+    assert stamped, "worker exited without flushing telemetry"
+    assert any("trace" in name for name in stamped)
+
+
+# ---------------------------------------------------------------------------
+# Stale heartbeat: a silent worker is quarantined, its queue re-routed
+# ---------------------------------------------------------------------------
+
+
+def test_stale_heartbeat_quarantines_and_reroutes_token_identical():
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9, 3, 7))
+    ref = _reference(model, params, prompts)
+    t, clock = _cell_clock()
+    cfg = ServingConfig(**{
+        **vars(_CFG), "router_policy": "round_robin", "slots": 1,
+    })
+    workers, router = _fleet(2, cfg, clock, model=model, params=params)
+    for j, p in enumerate(prompts):
+        router.submit(Request(prompt=list(p), max_new_tokens=9,
+                              request_id=j))
+    assert [router.routes[j] for j in range(4)] == [0, 1, 0, 1]
+    # Worker 0 wedges: it never pumps again, so it never reads its
+    # submits and never heartbeats. Past heartbeat_timeout_s the router
+    # must quarantine it on staleness alone (no socket error!) and
+    # re-route its still-queued share through the PR-14 path.
+    done = _drive(router, workers, t, dt=0.25, pump=workers[1:])
+    assert len(done) == 4
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    stats = router.stats()
+    assert stats["rerouted"] == 2
+    assert stats["failed"] == 0  # nothing was admitted on the wedged one
+    (q,) = stats["quarantined"]
+    assert q["replica"] == 0 and "StaleHeartbeat" in q["error"]
+    names = [e.get("event") for e in router.events]
+    assert names.count("replica_quarantined") == 1
+    assert names.count("request_rerouted") == 2
+    assert all(v == 1 for k, v in router.routes.items())
+
+
+def test_heartbeat_timeout_zero_disables_staleness_sweep():
+    t, clock = _cell_clock()
+    cfg = ServingConfig(**{**vars(_CFG), "heartbeat_timeout_s": 0.0})
+    workers, router = _fleet(1, cfg, clock)
+    t[0] += 3600.0
+    router.check_heartbeats()
+    assert not router.replicas[0].quarantined
+
+
+# ---------------------------------------------------------------------------
+# Op surface: poll streaming, drain ack, shutdown, EOF-as-shutdown
+# ---------------------------------------------------------------------------
+
+
+def _raw_worker(cfg, clock):
+    """A lone worker with the TEST as its router (raw frames)."""
+    model, params = _model_and_params()
+    router_side, worker_side = socket.socketpair()
+    router_side.setblocking(False)
+    worker_side.setblocking(False)
+    engine = ServingEngine(model, params, cfg, clock=clock)
+    w = ReplicaWorker(engine, worker_side, clock=clock,
+                      sleep=lambda s: None,
+                      heartbeat_interval_s=cfg.heartbeat_interval_s)
+    w.start()
+    return w, router_side, net.FrameDecoder()
+
+
+def _recv_all(sock, dec):
+    return net.recv_available(sock, dec) or []
+
+
+def test_poll_streams_token_deltas_then_shutdown_exits_zero():
+    t, clock = _cell_clock()
+    w, rsock, dec = _raw_worker(_CFG, clock)
+    _recv_all(rsock, dec)  # hello + first heartbeat
+    req = Request(prompt=[1, 2, 3, 4, 5], max_new_tokens=8, request_id=0)
+    net.send_frame(rsock, {
+        "op": "submit", "arrival_s": t[0],
+        "request": {
+            "prompt": req.prompt, "max_new_tokens": 8, "request_id": 0,
+        },
+    })
+    streamed = []
+    for _ in range(40):
+        t[0] += 0.01
+        w.pump()
+        net.send_frame(rsock, {"op": "poll"})
+        w.pump()
+        for msg in _recv_all(rsock, dec):
+            if msg.get("type") == "poll_reply":
+                streamed.extend(msg["deltas"].get("0", []))
+                assert "pending" in msg["gauges"]
+        if w.engine.scheduler.idle:
+            break
+    (final,) = w.engine.scheduler.finished
+    # Streaming polls saw a strict prefix-ordered view of the same tokens
+    # the result frame carries (the final tokens land in the result frame
+    # after the finish step, so polls may miss the tail — never reorder).
+    assert streamed == list(final.generated)[:len(streamed)]
+    assert len(streamed) > 0
+    net.send_frame(rsock, {"op": "drain"})
+    w.pump()
+    assert any(m.get("type") == "drained"
+               for m in _recv_all(rsock, dec))
+    net.send_frame(rsock, {"op": "shutdown"})
+    for _ in range(10):
+        t[0] += 0.01
+        if w.pump() is False and w.exit_code is not None:
+            break
+    assert w.exit_code == 0
+    assert any(m.get("type") == "goodbye" and m["exit"] == 0
+               for m in _recv_all(rsock, dec))
+
+
+def test_router_eof_is_a_clean_shutdown():
+    # A router that vanishes without a shutdown op must not strand the
+    # worker: EOF cuts intake, accepted work completes, exit code 0.
+    t, clock = _cell_clock()
+    w, rsock, dec = _raw_worker(_CFG, clock)
+    _recv_all(rsock, dec)
+    net.send_frame(rsock, {
+        "op": "submit", "arrival_s": t[0],
+        "request": {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                    "request_id": 0},
+    })
+    t[0] += 0.01
+    w.pump()  # reads the submit before the hangup
+    rsock.close()
+    for _ in range(40):
+        t[0] += 0.01
+        w.pump()
+        if w.exit_code is not None:
+            break
+    assert w.exit_code == 0
+    assert w.engine.draining
+    (final,) = w.engine.scheduler.finished
+    assert len(final.generated) == 4  # accepted work still completed
+
+
+def test_unknown_op_reports_error_without_dying():
+    t, clock = _cell_clock()
+    w, rsock, dec = _raw_worker(_CFG, clock)
+    _recv_all(rsock, dec)
+    net.send_frame(rsock, {"op": "frobnicate"})
+    w.pump()
+    (err,) = [m for m in _recv_all(rsock, dec)
+              if m.get("type") == "error"]
+    assert "frobnicate" in err["error"]
+    assert w.exit_code is None  # still serving
+
+
+# ---------------------------------------------------------------------------
+# cli fleet plumbing: pure plan, ready-line parsing
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_plan_is_pure_and_stamps_process_index():
+    base = {"PATH": "/bin", "COORDINATOR_ADDRESS": "h:1",
+            "NUM_PROCESSES": "8", "PROCESS_ID": "3"}
+    plan = _fleet_plan("cfg.py", ["serving.slots=4"], 3,
+                       host="10.0.0.5", port_base=7000,
+                       telemetry_dir="/tmp/tel", base_env=base)
+    assert len(plan) == 3
+    for i, (cmd, env) in enumerate(plan):
+        assert cmd[:3] == [sys.executable, "-m",
+                           "distributeddeeplearning_tpu.serving.worker"]
+        assert cmd[cmd.index("--replica-index") + 1] == str(i)
+        assert cmd[cmd.index("--port") + 1] == str(7000 + i)
+        assert cmd[cmd.index("--host") + 1] == "10.0.0.5"
+        assert cmd[cmd.index("--override") + 1] == "serving.slots=4"
+        assert cmd[cmd.index("--telemetry-dir") + 1] == "/tmp/tel"
+        # launch-child conventions: fleet stamp in, coordinator vars OUT
+        # (a fleet worker is single-process by construction).
+        assert env["DDL_PROCESS_INDEX"] == str(i)
+        assert "COORDINATOR_ADDRESS" not in env
+        assert "NUM_PROCESSES" not in env
+        assert "PROCESS_ID" not in env
+        assert env["PATH"] == "/bin"
+    # port_base=0 = every worker binds its own ephemeral port.
+    plan0 = _fleet_plan("cfg.py", [], 2, base_env=base)
+    assert all(cmd[cmd.index("--port") + 1] == "0" for cmd, _ in plan0)
+    assert base == {"PATH": "/bin", "COORDINATOR_ADDRESS": "h:1",
+                    "NUM_PROCESSES": "8", "PROCESS_ID": "3"}  # pure
+
+
+def test_read_worker_ready_skips_noise_and_errors_on_eof():
+    ready = {"event": "worker_ready", "host": "127.0.0.1", "port": 41234}
+    noise = []
+    stream = io.StringIO(
+        "some warning line\n" + json.dumps({"event": "other"}) + "\n"
+        + json.dumps(ready) + "\n"
+    )
+    got = read_worker_ready(stream, echo=noise.append)
+    assert got == ready
+    assert len(noise) == 2
+    with pytest.raises(RuntimeError, match="worker_ready"):
+        read_worker_ready(io.StringIO("crashed\n"))
+
+
+# ---------------------------------------------------------------------------
+# slow: one REAL worker subprocess end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_real_subprocess_worker_parity_and_clean_exit():
+    from distributeddeeplearning_tpu.serving.router import connect_fleet
+
+    spec = {
+        "model": {"name": "gpt2",
+                  "kwargs": {"size": "tiny", "vocab_size": 97,
+                             "max_len": 64}},
+        "serving": {"slots": 3, "block_size": 4, "hbm_budget_mb": 8,
+                    "max_seq_len": 48, "prompt_buckets": [8, 16]},
+    }
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributeddeeplearning_tpu.serving.worker",
+         "--spec-json", json.dumps(spec), "--seed", "7"],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        ready = read_worker_ready(proc.stdout)
+        cfg = ServingConfig(**{
+            **{k: tuple(v) if isinstance(v, list) else v
+               for k, v in spec["serving"].items()},
+        })
+        router = connect_fleet(cfg, [(ready["host"], ready["port"])])
+        model, params = _model_and_params()
+        prompts = _prompts((5, 9))
+        ref = _reference(model, params, prompts)
+        for p in prompts:
+            router.submit(Request(prompt=list(p), max_new_tokens=9))
+        done = router.run()
+        assert len(done) == 2
+        for s in done:
+            assert list(s.generated) == ref[s.request.request_id]
+        router.shutdown_fleet()
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.slow
+def test_real_subprocess_sigterm_exits_preempted():
+    import signal as _signal
+
+    spec = {
+        "model": {"name": "gpt2",
+                  "kwargs": {"size": "tiny", "vocab_size": 97,
+                             "max_len": 64}},
+        "serving": {"slots": 2, "block_size": 4, "hbm_budget_mb": 8,
+                    "max_seq_len": 48, "prompt_buckets": [8]},
+    }
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributeddeeplearning_tpu.serving.worker",
+         "--spec-json", json.dumps(spec)],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        ready = read_worker_ready(proc.stdout)
+        sock = socket.create_connection((ready["host"], ready["port"]),
+                                        timeout=30)
+        dec = net.FrameDecoder()
+        net.recv_frames_blocking(sock, dec)  # hello (+ heartbeat)
+        net.send_frame(sock, {
+            "op": "submit", "arrival_s": time.monotonic(),
+            "request": {"prompt": [1, 2, 3], "max_new_tokens": 6,
+                        "request_id": 0},
+        })
+        seen = {}
+        while "admitted" not in seen:  # request is genuinely in flight
+            for msg in net.recv_frames_blocking(sock, dec, timeout_s=30):
+                seen[msg.get("type") or msg.get("op")] = msg
+        proc.send_signal(_signal.SIGTERM)
+        # The preempted worker still finishes the accepted request and
+        # pushes its result before the goodbye.
+        deadline = time.monotonic() + 60
+        while "goodbye" not in seen and time.monotonic() < deadline:
+            for msg in net.recv_frames_blocking(sock, dec, timeout_s=30):
+                seen[msg.get("type") or msg.get("op")] = msg
+        assert seen["goodbye"]["exit"] == EXIT_PREEMPTED
+        assert len(seen["result"]["state"]["generated"]) == 6
+        assert proc.wait(timeout=60) == EXIT_PREEMPTED
+    finally:
+        if proc.poll() is None:
+            proc.kill()

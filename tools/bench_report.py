@@ -27,7 +27,8 @@ $DDL_REPORT_OUT).
 ``python tools/bench_report.py --check`` validates the COMMITTED
 artifacts this index points at without re-measuring: today that means
 BENCH_SERVING.json's router block (the scale-out + shedding claims),
-prefix_cache block (the shared-prefix KV-reuse reduction, parity, and
+fleet block (the wall-clock socket-worker scale-out, oracle parity, and
+overload accounting), prefix_cache block (the shared-prefix KV-reuse reduction, parity, and
 adversarial control), kv_hierarchy block (the spill-tier hit-token
 recovery, fp parity, and int8 controls), and kv_quant block (the
 quantized device pool's >= 2x block-capacity ratio, token parity, and
@@ -107,6 +108,15 @@ def _headline(rec: dict) -> dict:
                   "tokens_match_reference"):
             if k in rtr["comparison"]:
                 out["router_" + k] = rtr["comparison"][k]
+    # Serving socket-fleet block: the wall-clock headline — real child
+    # worker processes, tokens/s at 4 socket workers over 1 at
+    # saturating load, greedy parity vs the direct single-engine oracle.
+    flt = rec.get("fleet")
+    if isinstance(flt, dict) and isinstance(flt.get("comparison"), dict):
+        for k in ("wallclock_tps_ratio_4x", "tokens_match_oracle",
+                  "shed_accounting_exact"):
+            if k in flt["comparison"]:
+                out["fleet_" + k] = flt["comparison"][k]
     # Serving prefix-cache block: the KV-reuse headline — prefill tokens
     # removed by the trie on the shared-prefix trace, the warm TTFT win,
     # and the honest ~0 hit rate on the adversarial control.
@@ -238,6 +248,19 @@ def check() -> int:
           rcomp.get("zero_recompiles_per_replica") is True)
     claim("p99_ttft_bounded_under_shedding",
           rcomp.get("p99_ttft_bounded_under_shedding") is True)
+    # The socket-fleet block (real child worker processes on the wall
+    # clock): the scale-out headline, oracle parity, per-worker compile
+    # pins, and exact overload accounting.
+    fcomp = (serving.get("fleet") or {}).get("comparison", {})
+    claim("fleet block present", bool(fcomp))
+    claim("fleet wallclock_tps_ratio_4x >= 2.5",
+          (fcomp.get("wallclock_tps_ratio_4x") or 0) >= 2.5)
+    claim("fleet tokens_match_oracle",
+          fcomp.get("tokens_match_oracle") is True)
+    claim("fleet zero_recompiles_per_worker",
+          fcomp.get("zero_recompiles_per_worker") is True)
+    claim("fleet shed_accounting_exact",
+          fcomp.get("shed_accounting_exact") is True)
     # The prefix-cache block (shared-prefix KV reuse): the headline
     # reduction, parity, and the honest adversarial control.
     pcomp = serving.get("prefix_cache", {}).get("comparison", {})
@@ -303,6 +326,12 @@ def check() -> int:
         claim("trajectory carries router_shed_rate_100x_1_replica",
               head.get("router_shed_rate_100x_1_replica")
               == rcomp.get("shed_rate_100x_1_replica"))
+        claim("trajectory carries fleet_wallclock_tps_ratio_4x",
+              head.get("fleet_wallclock_tps_ratio_4x")
+              == fcomp.get("wallclock_tps_ratio_4x"))
+        claim("trajectory carries fleet_tokens_match_oracle",
+              head.get("fleet_tokens_match_oracle")
+              == fcomp.get("tokens_match_oracle"))
         claim("trajectory carries prefix_prefill_token_reduction_shared",
               head.get("prefix_prefill_token_reduction_shared")
               == pcomp.get("prefill_token_reduction_shared"))
