@@ -684,6 +684,8 @@ def _free_port() -> int:
 def _fleet_plan(config: str, overrides: list[str], fleet: int, *,
                 host: str = "127.0.0.1", port_base: int = 0,
                 telemetry_dir: str | None = None,
+                spill_dir: str | None = None,
+                worker_args: list[str] | None = None,
                 base_env: dict | None = None):
     """``[(cmd, env), ...]`` for every worker of ``cli serve --fleet N``
     — pure (no processes spawned), so tests can pin the plan.
@@ -711,6 +713,14 @@ def _fleet_plan(config: str, overrides: list[str], fleet: int, *,
             cmd += ["--override", o]
         if telemetry_dir:
             cmd += ["--telemetry-dir", telemetry_dir]
+        if spill_dir:
+            # Per-worker KV spill checkpoint file: the fleet supervisor's
+            # restart re-warms worker i from exactly the store worker i
+            # checkpointed (indices are stable across restarts).
+            cmd += ["--spill-store",
+                    os.path.join(spill_dir, f"spill_w{i}.json")]
+        if worker_args:
+            cmd += list(worker_args)
         env = dict(os.environ if base_env is None else base_env)
         for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
             env.pop(k, None)
@@ -744,8 +754,19 @@ def cmd_serve_fleet(args) -> int:
     in-process ``serving.replicas`` path, same dispatch/shed/drain/
     quarantine policy code. Like ``launch``, this runs BEFORE
     init_distributed: the parent is a process babysitter plus a socket
-    client; the engines (and devices) belong to the children."""
+    client; the engines (and devices) belong to the children.
+
+    The parent is not just a babysitter anymore: it runs a
+    :class:`~.serving.fleet_supervisor.FleetSupervisor` control loop —
+    a worker that exits, drops its socket, or goes heartbeat-silent is
+    classified (supervisor.py taxonomy), its in-flight work retried on
+    the survivors under a bumped attempt epoch, and the process itself
+    restarted with exponential backoff (``serving.max_worker_restarts``
+    / ``restart_backoff_*``), re-warming its KV spill tier from the
+    ``--spill-store`` file it checkpointed (docs/FAULT_TOLERANCE.md)."""
+    import os
     import subprocess
+    import tempfile
     import threading
 
     from .config import apply_overrides, load_config
@@ -755,11 +776,15 @@ def cmd_serve_fleet(args) -> int:
         check_serving_composition,
         connect_fleet,
     )
+    from .serving.fleet_supervisor import FleetSupervisor
+    from .serving.worker import ATTEMPT_ENV
     from .telemetry import resolve_dir
 
     cfg = apply_overrides(load_config(args.config), args.override)
     # Composition fences FIRST — fail by name before any child spawns.
-    check_serving_composition(cfg)
+    # fleet=args.fleet arms the self-healing fences (fault injection is
+    # fleet-only; restart knobs must be sane).
+    check_serving_composition(cfg, fleet=args.fleet)
     check_fleet_composition(cfg.serving, args.fleet)
     if (args.temperature > 0
             and getattr(cfg.serving, "speculation", "off") != "off"):
@@ -771,34 +796,71 @@ def cmd_serve_fleet(args) -> int:
     if any(not p for p in args.prompt):
         raise ValueError("prompt must be non-empty")
     tdir = resolve_dir(cfg) if cfg.telemetry.enabled else None
+    # The KV re-warm chain needs a durable spill store per worker; only
+    # meaningful when the spill tier exists at all.
+    spill_dir = None
+    if getattr(cfg.serving, "spill_blocks", 0) > 0:
+        spill_dir = tdir or tempfile.mkdtemp(prefix="ddl_fleet_spill_")
     plan = _fleet_plan(
         args.config, args.override, args.fleet,
         host=cfg.serving.worker_host,
         port_base=cfg.serving.worker_port,
         telemetry_dir=tdir,
+        spill_dir=spill_dir,
     )
-    procs, threads, endpoints = [], [], []
+    procs = [None] * args.fleet
+    threads, endpoints = [], []
+
+    def _attach_stream(index, p):
+        t = threading.Thread(
+            target=_stream_prefixed,
+            args=(p.stdout, f"[w{index}] ", sys.stdout),
+            daemon=True,
+        )
+        t.start()
+        threads.append(t)
+
+    def _spawn_worker(index, attempt):
+        """FleetSupervisor spawn hook: (re)launch worker ``index`` as
+        restart ``attempt`` (stamped into $DDL_WORKER_ATTEMPT so one-shot
+        fault injection never re-fires on the respawned process) and
+        block until its ``worker_ready`` line."""
+        cmd, env = plan[index]
+        env = dict(env)
+        env[ATTEMPT_ENV] = str(attempt)
+        p = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        procs[index] = p
+        ready = read_worker_ready(
+            p.stdout,
+            echo=lambda line: sys.stdout.write(f"[w{index}] {line}"),
+        )
+        _attach_stream(index, p)
+        return p, ready
+
     try:
+        # Initial boot stays parallel: spawn everyone, then collect the
+        # ready lines (warmup compiles overlap across workers).
         for i, (cmd, env) in enumerate(plan):
-            p = subprocess.Popen(
+            env = dict(env)
+            env[ATTEMPT_ENV] = "0"
+            procs[i] = subprocess.Popen(
                 cmd, env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True,
             )
-            procs.append(p)
         for i, p in enumerate(procs):
             ready = read_worker_ready(
                 p.stdout,
                 echo=lambda line, i=i: sys.stdout.write(f"[w{i}] {line}"),
             )
             endpoints.append((ready["host"], ready["port"]))
-            t = threading.Thread(
-                target=_stream_prefixed,
-                args=(p.stdout, f"[w{i}] ", sys.stdout),
-                daemon=True,
-            )
-            t.start()
-            threads.append(t)
+            _attach_stream(i, p)
         router = connect_fleet(cfg.serving, endpoints)
+        supervisor = FleetSupervisor(
+            router, procs, _spawn_worker, cfg.serving,
+        )
         for p_text in args.prompt:
             router.submit(Request(
                 prompt=list(p_text.encode("utf-8")),
@@ -806,16 +868,18 @@ def cmd_serve_fleet(args) -> int:
                 temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p,
             ))
-        finished = router.run()
+        finished = supervisor.run()
         stats, events = router.stats(), router.events
-        router.shutdown_fleet()
+        supervisor.shutdown()
     finally:
         for p in procs:
+            if p is None:
+                continue
             try:
                 p.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 p.terminate()
-    rcs = [p.wait() for p in procs]
+    rcs = [p.wait() for p in procs if p is not None]
     for t in threads:
         t.join(timeout=5)
     results = []
@@ -831,6 +895,8 @@ def cmd_serve_fleet(args) -> int:
         "results": results,
         "stats": stats,
         "events": events,
+        "supervisor": supervisor.stats(),
+        "supervisor_events": supervisor.events,
         "worker_exit_codes": rcs,
     }
     if tdir:

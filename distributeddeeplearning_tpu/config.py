@@ -450,15 +450,59 @@ class ServingConfig:
     heartbeat_interval_s: float = 0.05
     # Quarantine a socket replica after this many seconds without a
     # heartbeat: its queued (never-admitted) requests reroute to the
-    # survivors, its in-flight requests are reported lost. 0 disables
-    # staleness quarantine; when > 0 it must exceed
-    # heartbeat_interval_s — fenced by name.
-    heartbeat_timeout_s: float = 1.0
+    # survivors, its in-flight requests retry on them under a bumped
+    # attempt epoch. 0 disables staleness quarantine; when > 0 it must
+    # exceed heartbeat_interval_s — fenced by name. The sweep cannot
+    # see inside a worker: a single-threaded worker cannot heartbeat
+    # mid-engine-step, and a fresh process's first step can sit in XLA
+    # compilation for multiple seconds, so the default must sit above
+    # worst-case cold-step latency or every cold boot false-trips a
+    # hang quarantine + respawn (a fresh CPU-sim worker's first step —
+    # backend init + prefill compile — has been observed at ~5s).
+    heartbeat_timeout_s: float = 10.0
     # Interface fleet workers bind/advertise. Workers always bind an
     # ephemeral port unless worker_port > 0 (then worker i binds
     # worker_port + i).
     worker_host: str = "127.0.0.1"
     worker_port: int = 0
+    # Fleet self-healing (serving/fleet_supervisor.py; docs/
+    # FAULT_TOLERANCE.md serving section). Per-worker restart budget: a
+    # dead worker (crash / hang / lost socket) is respawned up to this
+    # many times with exponential backoff; once exhausted the fleet
+    # degrades gracefully to the survivors. 0 = never restart (PR 18
+    # behavior: quarantine forever). Must be >= 0 — fenced by name.
+    max_worker_restarts: int = 3
+    # Exponential-backoff schedule between respawns of the SAME worker:
+    # sleep min(base * 2**k, max) * (1 + 0.1*jitter) before attempt k.
+    # Mirrors the training supervisor's schedule (supervisor.py).
+    restart_backoff_base_s: float = 0.5
+    restart_backoff_max_s: float = 15.0
+    # Seconds between a worker's periodic KV spill-store checkpoints
+    # (engine.save_spill_store) — the persistence a RESTARTED worker
+    # re-warms its host tier from (crashes can't run the drain-time
+    # save). 0 = only checkpoint on clean drain/SIGTERM. Requires
+    # spill_blocks > 0 to matter; fenced by name when set without it.
+    spill_checkpoint_every_s: float = 0.0
+    # At-most-once retry of IN-FLIGHT requests when their worker dies:
+    # true re-submits them on a live survivor under a bumped attempt
+    # epoch (late/duplicate result frames from the half-dead worker are
+    # discarded by epoch — never double-delivered); false keeps the
+    # PR 18 behavior (in-flight requests die as request_failed). Queued
+    # never-admitted requests reroute token-identically either way.
+    request_retry: bool = True
+    # Fault-injection DSL for the serving chaos harness
+    # (tools/serve_chaos.py): "" = off, else one of
+    # 'worker_crash:K' (os._exit(EXIT_FAULT) at engine step K),
+    # 'worker_hang:K' (stop reading/heartbeating/stepping at step K;
+    # process stays alive), 'conn_drop:K' (close the router socket at
+    # step K), 'heartbeat_stall:K' (suppress heartbeats from step K on
+    # while SERVING CONTINUES — the half-dead duplicate-result case).
+    # One-shot and armed per-process like the training faults: only the
+    # worker whose replica index matches $DDL_SERVE_FAULT_WORKER
+    # (default 0) on its FIRST attempt fires; restarts are disarmed via
+    # the attempt env. Fleet-only — fenced by name under in-process
+    # `serve` (check_serving_composition).
+    fault_injection: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

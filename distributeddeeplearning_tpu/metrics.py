@@ -34,9 +34,14 @@ def event_record(name: str, step: int, **fields) -> dict:
 # - request_failed: the request was in flight on a replica whose step()
 #   raised — its partial output is lost (queued requests re-route; KV state
 #   of admitted ones dies with the replica).
+# - request_retried: an IN-FLIGHT request on a dead replica was re-submitted
+#   from scratch on a survivor (serving.request_retry) under a bumped
+#   attempt epoch — greedy decode makes the retry token-identical, and any
+#   late result frame from the dead attempt is discarded by epoch.
 SERVING_EVENTS = (
     "request_admitted", "first_token", "request_completed",
     "request_shed", "request_rerouted", "request_failed",
+    "request_retried",
 )
 
 

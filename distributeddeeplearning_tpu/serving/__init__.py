@@ -8,10 +8,16 @@ are split so admission policy is unit-testable without a device.
 from .engine import (  # noqa: F401
     ROUTER_POLICIES,
     SERVABLE_MODELS,
+    SERVE_FAULT_KINDS,
     SHED_POLICIES,
     ServingEngine,
     check_serving_composition,
+    parse_fault_injection,
     speculation_k,
+)
+from .fleet_supervisor import (  # noqa: F401
+    FleetSupervisor,
+    WorkerDied,
 )
 from .net import (  # noqa: F401
     MAX_FRAME_BYTES,
@@ -26,6 +32,7 @@ from .router import (  # noqa: F401
     SocketReplica,
     StaleHeartbeat,
     connect_fleet,
+    dial_worker,
 )
 from .quant import (  # noqa: F401
     dequantize_params,

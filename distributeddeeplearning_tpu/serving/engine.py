@@ -288,9 +288,86 @@ def _check_kv_quant(kv_quant, spill_codec) -> str:
     return mode
 
 
-def check_serving_composition(cfg) -> None:
+# Fault classes the serving chaos DSL understands (config.py
+# serving.fault_injection; armed in serving/worker.py, driven by
+# tools/serve_chaos.py). Each spec is '<kind>:<step K>'.
+SERVE_FAULT_KINDS = (
+    "worker_crash", "worker_hang", "conn_drop", "heartbeat_stall"
+)
+
+
+def parse_fault_injection(spec) -> "tuple[str, int] | None":
+    """Parse ``serving.fault_injection`` ('' or '<kind>:K') into
+    ``(kind, step)``. Raises by name on unknown kinds or a bad step so a
+    typo'd chaos spec dies at config time, not silently un-armed."""
+    text = str(spec or "").strip()
+    if not text:
+        return None
+    kind, sep, raw_step = text.partition(":")
+    if kind not in SERVE_FAULT_KINDS:
+        raise ValueError(
+            f"serving.fault_injection kind must be one of "
+            f"{SERVE_FAULT_KINDS}, got {spec!r}"
+        )
+    try:
+        step = int(raw_step)
+    except ValueError:
+        step = -1
+    if not sep or step < 0:
+        raise ValueError(
+            f"serving.fault_injection={spec!r}: expected '<kind>:K' with "
+            "integer step K >= 0 (the engine step at which the armed "
+            "worker fires the fault)"
+        )
+    return kind, step
+
+
+def _check_fleet_healing(s, fleet: int) -> None:
+    """Self-healing knob fences (config time, by name): restart budget,
+    backoff shape, spill-checkpoint cadence, and the fault-injection DSL
+    (fleet-only — an in-process engine has no process to kill)."""
+    restarts = getattr(s, "max_worker_restarts", 0)
+    if restarts < 0:
+        raise ValueError(
+            f"serving.max_worker_restarts must be >= 0 (0 = never "
+            f"restart, quarantine forever), got {restarts}"
+        )
+    base = getattr(s, "restart_backoff_base_s", 0.5)
+    cap = getattr(s, "restart_backoff_max_s", 15.0)
+    if base <= 0 or cap < base:
+        raise ValueError(
+            "serving restart backoff must satisfy 0 < "
+            f"restart_backoff_base_s <= restart_backoff_max_s, got "
+            f"base={base} max={cap}"
+        )
+    cadence = getattr(s, "spill_checkpoint_every_s", 0.0)
+    if cadence < 0:
+        raise ValueError(
+            "serving.spill_checkpoint_every_s must be >= 0 (0 = "
+            f"checkpoint only on clean drain), got {cadence}"
+        )
+    if cadence > 0 and not getattr(s, "spill_blocks", 0):
+        raise ValueError(
+            "serving.spill_checkpoint_every_s x spill_blocks=0: the "
+            "periodic checkpoint persists the host spill tier, which "
+            "spill_blocks=0 disables — a silently ignored cadence is a "
+            "config bug; set spill_blocks > 0 or drop the cadence"
+        )
+    fault = parse_fault_injection(getattr(s, "fault_injection", ""))
+    if fault is not None and fleet < 1:
+        raise NotImplementedError(
+            f"serving.fault_injection={s.fault_injection!r} x in-process "
+            "serve: fault injection kills/wedges a WORKER PROCESS, which "
+            "only exists under `serve --fleet N` — run a fleet or drop "
+            "the fault spec"
+        )
+
+
+def check_serving_composition(cfg, *, fleet: int = 0) -> None:
     """Config-time composition fences for ``serve`` (PR-5 style: fail BY
-    NAME before any compile). ``cfg`` is the full Config."""
+    NAME before any compile). ``cfg`` is the full Config. ``fleet`` is
+    the ``--fleet N`` worker count (0 = in-process serve) — some knobs
+    are only legal when real worker processes exist."""
     name = cfg.model.name
     if name.endswith("_pp"):
         raise NotImplementedError(
@@ -407,6 +484,9 @@ def check_serving_composition(cfg) -> None:
     _check_speculation(
         getattr(s, "speculation", "off"), s.block_size, kernel
     )
+    # Fleet self-healing fences (restart budget / backoff / checkpoint
+    # cadence / fault-injection DSL).
+    _check_fleet_healing(s, fleet)
 
 
 class ServingEngine:

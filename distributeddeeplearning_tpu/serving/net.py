@@ -104,13 +104,21 @@ def send_frame(sock: socket.socket, obj, *,
                timeout_s: float = 30.0) -> None:
     """Write one frame, handling nonblocking sockets: on a full send
     buffer, wait for writability (up to ``timeout_s``) and continue.
-    Raises ``ProtocolError`` on timeout, ``OSError`` on a dead peer."""
+    Every failure mode — timeout, dead peer (EPIPE/ECONNRESET) mid-
+    write — raises typed :class:`ProtocolError`: a partial frame has no
+    resync point, so the connection is dead either way, and callers get
+    ONE exception type for 'this peer is gone' instead of fishing raw
+    ``OSError`` out of the middle of a write."""
     data = memoryview(encode_frame(obj, max_bytes=max_bytes))
     while data:
         try:
             sent = sock.send(data)
         except (BlockingIOError, InterruptedError):
             sent = 0
+        except OSError as exc:
+            raise ProtocolError(
+                f"send_frame: peer gone mid-write ({exc})"
+            ) from exc
         if not sent:
             _, writable, _ = select.select([], [sock], [], timeout_s)
             if not writable:
@@ -120,6 +128,40 @@ def send_frame(sock: socket.socket, obj, *,
                 )
             continue
         data = data[sent:]
+
+
+def connect_with_retry(host: str, port: int, *,
+                       deadline_s: float = 60.0,
+                       backoff_base_s: float = 0.05,
+                       backoff_max_s: float = 1.0,
+                       clock=None, sleep=None) -> socket.socket:
+    """Dial ``(host, port)`` with bounded retry + exponential backoff.
+
+    Fleet bring-up races the router's dial against N workers' bind/
+    listen: a worker that printed ``worker_ready`` has bound its port,
+    but a slow-to-accept (or just-restarted) worker can still refuse the
+    first SYN. Retrying here — instead of failing the whole ``--fleet``
+    launch on one ECONNREFUSED — is what makes both cold bring-up and
+    supervisor re-dial after a worker restart robust. Raises the last
+    ``OSError`` once ``deadline_s`` is spent."""
+    import time as _time
+
+    clock = clock if clock is not None else _time.monotonic
+    sleep = sleep if sleep is not None else _time.sleep
+    deadline = clock() + deadline_s
+    attempt = 0
+    while True:
+        budget = deadline - clock()
+        try:
+            return socket.create_connection(
+                (host, int(port)), timeout=max(0.05, budget)
+            )
+        except OSError:
+            pause = min(backoff_base_s * (2 ** attempt), backoff_max_s)
+            if clock() + pause >= deadline:
+                raise
+            attempt += 1
+            sleep(pause)
 
 
 def recv_available(sock: socket.socket, decoder: FrameDecoder,

@@ -160,11 +160,16 @@ class Replica:
         )
         return queue_wait, pct("prefill"), g["pending"]
 
-    def submit_request(self, request: Request,
-                       arrival_s: float) -> RequestState:
+    def submit_request(self, request: Request, arrival_s: float,
+                       epoch: int = 0) -> RequestState:
+        # ``epoch`` is the request's attempt number (router-side retry
+        # ledger). In-process engines deliver results synchronously —
+        # there is no late frame to discard — so it is accepted for
+        # surface parity and ignored.
         return self.engine.submit(request, arrival_s)
 
-    def reroute_in(self, request: Request, arrival_s: float) -> None:
+    def reroute_in(self, request: Request, arrival_s: float,
+                   epoch: int = 0) -> None:
         # Straight into the scheduler, bypassing the draining check the
         # front door applies: rerouted work was ALREADY accepted.
         self.engine.scheduler.submit(request, arrival_s)
@@ -256,13 +261,21 @@ class SocketReplica:
         self._digests: frozenset[bytes] = frozenset()
         self._est_queue_wait_s = 0.0
         self._est_prefill_s = 0.0
-        # Submit ledger: request_id -> (Request, arrival_s). A request
-        # leaves ``_queued`` on the worker's ``admitted`` frame and the
-        # whole ledger on its ``result`` frame.
-        self._outstanding: dict[int, tuple[Request, float]] = {}
+        # Submit ledger: request_id -> (Request, arrival_s, epoch). A
+        # request leaves ``_queued`` on the worker's ``admitted`` frame
+        # and the whole ledger on its ``result`` frame. ``epoch`` is the
+        # attempt number the router submitted under — a late frame from
+        # a half-dead worker carries the OLD epoch and is discarded
+        # (``stale_frames``), never double-delivered.
+        self._outstanding: dict[int, tuple[Request, float, int]] = {}
         self._queued: set[int] = set()
         self._results: dict[int, RequestState] = {}
         self._stream: dict[int, list[int]] = {}
+        #: Discarded admitted/result frames: unknown request id, epoch
+        #: mismatch, or a duplicate of an already-recorded result.
+        self.stale_frames = 0
+        #: Out-of-order heartbeats dropped by the seq check.
+        self.stale_heartbeats = 0
         self.goodbye: dict | None = None
         for msg in backlog:
             # Frames the handshake read past the hello (e.g. the first
@@ -320,22 +333,36 @@ class SocketReplica:
         )
         return queue_wait, self._est_prefill_s, g["pending"]
 
-    def submit_request(self, request: Request,
-                       arrival_s: float) -> RequestState:
+    def submit_request(self, request: Request, arrival_s: float,
+                       epoch: int = 0) -> RequestState:
         rid = int(request.request_id)
         net.send_frame(self.sock, {
             "op": "submit",
             "arrival_s": arrival_s,
+            "epoch": int(epoch),
             "request": _request_to_wire(request),
         })
-        self._outstanding[rid] = (request, arrival_s)
+        self._outstanding[rid] = (request, arrival_s, int(epoch))
         self._queued.add(rid)
         # Placeholder state (the authoritative one lives worker-side and
         # comes back in the result frame).
         return RequestState(request=request, arrival_s=arrival_s)
 
-    def reroute_in(self, request: Request, arrival_s: float) -> None:
-        self.submit_request(request, arrival_s)
+    def reroute_in(self, request: Request, arrival_s: float,
+                   epoch: int = 0) -> None:
+        # ``reroute`` makes the worker bypass its engine's draining
+        # front door (scheduler.submit, same as the in-process
+        # Replica.reroute_in): displaced work was ALREADY accepted.
+        rid = int(request.request_id)
+        net.send_frame(self.sock, {
+            "op": "submit",
+            "arrival_s": arrival_s,
+            "epoch": int(epoch),
+            "reroute": True,
+            "request": _request_to_wire(request),
+        })
+        self._outstanding[rid] = (request, arrival_s, int(epoch))
+        self._queued.add(rid)
 
     def step(self) -> bool:
         """Pump the socket: drain readable frames, fold pushed state in.
@@ -352,11 +379,33 @@ class SocketReplica:
             self._handle(msg)
         return bool(self._outstanding)
 
+    def _frame_epoch_ok(self, msg: dict) -> "tuple[int, bool]":
+        """(request_id, accept?) for an admitted/result frame: the frame
+        must name a ledgered request AND carry the epoch the router
+        submitted it under. A late frame from a previous attempt (the
+        half-dead-worker case) or for an already-resolved request is
+        discarded with ``stale_frames`` incremented — at-most-once
+        delivery lives or dies on this check."""
+        rid = int(msg["request_id"])
+        entry = self._outstanding.get(rid)
+        if entry is None or int(msg.get("epoch", 0)) != entry[2]:
+            self.stale_frames += 1
+            return rid, False
+        return rid, True
+
     def _handle(self, msg: dict) -> None:
         kind = msg.get("type")
         if kind == "heartbeat":
+            seq = int(msg.get("seq", -1))
+            if seq <= self.heartbeat_seq:
+                # Out-of-order delivery (or a replayed frame): fresher
+                # gauges are already folded in — letting an older
+                # heartbeat through would roll load signals BACK and
+                # reset the staleness clock of a worker that re-stalled.
+                self.stale_heartbeats += 1
+                return
             self.last_heartbeat_s = self._clock()
-            self.heartbeat_seq = int(msg.get("seq", -1))
+            self.heartbeat_seq = seq
             self.hb_gauges = dict(msg.get("gauges") or {})
             self.hb_stats = dict(msg.get("stats") or {})
             self.num_compiles = int(
@@ -371,19 +420,22 @@ class SocketReplica:
                 "op": "heartbeat_ack", "seq": self.heartbeat_seq,
             })
         elif kind == "admitted":
-            self._queued.discard(int(msg["request_id"]))
+            rid, ok = self._frame_epoch_ok(msg)
+            if ok:
+                self._queued.discard(rid)
         elif kind == "token_delta":
             self._stream.setdefault(
                 int(msg["request_id"]), []
             ).extend(int(t) for t in msg.get("tokens", ()))
         elif kind == "result":
-            rid = int(msg["request_id"])
-            entry = self._outstanding.pop(rid, None)
+            rid, ok = self._frame_epoch_ok(msg)
+            if not ok:
+                return
+            entry = self._outstanding.pop(rid)
             self._queued.discard(rid)
-            if entry is not None:
-                self._results[rid] = _state_from_wire(
-                    entry[0], msg["state"]
-                )
+            self._results[rid] = _state_from_wire(
+                entry[0], msg["state"]
+            )
         elif kind == "submit_error":
             rid = int(msg["request_id"])
             self._outstanding.pop(rid, None)
@@ -399,7 +451,8 @@ class SocketReplica:
     def take_queued(self) -> list[tuple[Request, float]]:
         out = []
         for rid in sorted(self._queued):
-            out.append(self._outstanding.pop(rid))
+            request, arrival_s, _epoch = self._outstanding.pop(rid)
+            out.append((request, arrival_s))
         self._queued.clear()
         return out
 
@@ -412,7 +465,7 @@ class SocketReplica:
         for rid in sorted(self._outstanding):
             if rid in self._queued:
                 continue
-            request, arrival_s = self._outstanding[rid]
+            request, arrival_s, _epoch = self._outstanding[rid]
             state = RequestState(request=request, arrival_s=arrival_s)
             state.dropped = True
             out.append(state)
@@ -437,6 +490,8 @@ class SocketReplica:
             "num_compiles": self.num_compiles,
             "heartbeat_seq": self.heartbeat_seq,
             "dropped": self.dropped_count,
+            "stale_frames": self.stale_frames,
+            "stale_heartbeats": self.stale_heartbeats,
             **self.hb_stats,
         }
 
@@ -455,7 +510,7 @@ class SocketReplica:
     def shutdown(self) -> None:
         try:
             self.send_op("shutdown")
-        except OSError:
+        except (OSError, net.ProtocolError):
             pass
 
     def close(self) -> None:
@@ -586,6 +641,10 @@ class ReplicaRouter:
         self.heartbeat_timeout_s = float(
             getattr(cfg, "heartbeat_timeout_s", 0.0) or 0.0
         )
+        #: Last staleness-sweep timestamp — lets the sweep tell a
+        #: worker's silence apart from its OWN pause (see
+        #: :meth:`check_heartbeats`).
+        self._last_sweep_s: float | None = None
         # Socket pump idle wait (real-clock fleets only): step() blocks
         # up to this long on the fleet's sockets when a tick moved
         # nothing, instead of burning the workers' CPU in a hot poll.
@@ -623,6 +682,18 @@ class ReplicaRouter:
         self.failed: list[RequestState] = []
         self.rerouted = 0
         self.tick_count = 0
+        # At-most-once retry ledger (serving.request_retry): request_id
+        # -> attempt epoch. Every reroute/retry bumps the epoch; the
+        # epoch travels in the submit frame and comes back on every
+        # admitted/result frame, so late frames from a previous attempt
+        # are discarded transport-side (SocketReplica.stale_frames).
+        self.request_retry = bool(getattr(cfg, "request_retry", False))
+        self.epochs: dict[int, int] = {}
+        self.retried = 0
+        #: Same-rid results observed on TWO replicas by ``finished()`` —
+        #: the double-delivery the epoch discipline exists to prevent
+        #: (chaos pins this at 0).
+        self.duplicate_deliveries = 0
 
     # ------------------------------------------------------------------
     # dispatch
@@ -746,8 +817,19 @@ class ReplicaRouter:
                     rec,
                 )
         # Arrival stamped with the ROUTER's now: the request arrived when
-        # it hit the router, whatever the replica's clock reads.
-        state = replica.submit_request(request, now)
+        # it hit the router, whatever the replica's clock reads. A
+        # submit that dies on the wire (ProtocolError from a peer that
+        # vanished since its last heartbeat) quarantines that replica
+        # and re-picks — the caller never sees a transport fault for a
+        # request no worker ever owned.
+        epoch = self.epochs.setdefault(int(request.request_id), 0)
+        while True:
+            try:
+                state = replica.submit_request(request, now, epoch)
+                break
+            except net.ProtocolError as exc:
+                self._quarantine(replica, exc)
+                replica = self._pick(now, request)
         self.routes[int(request.request_id)] = replica.index
         return state
 
@@ -795,10 +877,32 @@ class ReplicaRouter:
         ``serving.heartbeat_timeout_s`` (0 = sweep disabled). Runs
         through the SAME quarantine path as a step fault: in-flight
         work on the stale worker is reported lost, queued work reroutes
-        to the survivors."""
+        to the survivors.
+
+        Pause-aware: when the ROUTER itself went dark between sweeps —
+        blocked in a supervisor respawn (worker boot + dial can take
+        seconds), a host stall, a GC-style pause — silence over that
+        window says nothing about the workers, whose heartbeats were
+        piling up in socket buffers nobody pumped. Charging them for
+        our own dead air quarantines healthy workers and (worst case)
+        cascades: each false restart blocks the router again and
+        condemns the next survivor. So a sweep gap larger than half the
+        timeout is credited back to every live replica and detection
+        resumes from now — a genuinely stalled worker still ages across
+        the normal millisecond-cadence sweeps."""
         if not self.heartbeat_timeout_s:
             return
         now = self.clock() if now is None else now
+        prev, self._last_sweep_s = self._last_sweep_s, now
+        if prev is not None:
+            gap = now - prev
+            if gap > self.heartbeat_timeout_s / 2.0:
+                for r in self.replicas:
+                    if r.heartbeat_expected and not r.quarantined:
+                        r.last_heartbeat_s = min(
+                            r.last_heartbeat_s + gap, now
+                        )
+                return
         for r in self.replicas:
             if not r.heartbeat_expected or r.quarantined:
                 continue
@@ -810,6 +914,30 @@ class ReplicaRouter:
                     f"{self.heartbeat_timeout_s})"
                 ))
 
+    def _bump_epoch(self, rid: int) -> int:
+        epoch = self.epochs.get(rid, 0) + 1
+        self.epochs[rid] = epoch
+        return epoch
+
+    def _retry_target(self, now: float,
+                      request: Request | None = None) -> Replica | None:
+        """Survivor for quarantine-displaced work. Normal dispatch when
+        any non-draining replica is live; as a LAST RESORT a live
+        draining replica — drain closes the front door to NEW work, but
+        displaced work was already accepted, and failing it while a live
+        engine could still serve it would break the self-healing
+        contract. None = fleet fully dark."""
+        if self._live():
+            return self._pick(now, request)
+        draining = [r for r in self.replicas
+                    if r.draining and not r.quarantined]
+        if not draining:
+            return None
+        return min(
+            draining,
+            key=lambda r: (r.load_gauges(now)["pending"], r.index),
+        )
+
     def _quarantine(self, replica: Replica, exc: Exception) -> None:
         replica.quarantined = True
         replica.error = f"{type(exc).__name__}: {exc}"
@@ -817,34 +945,76 @@ class ReplicaRouter:
             "replica_quarantined", self.tick_count,
             replica=replica.index, error=replica.error,
         ))
-        # In-flight requests die with the replica: their KV lives in its
-        # pool and cannot be recovered. Report each loss, typed.
+        now = self.clock()
+        # In-flight requests lost their KV with the replica. With
+        # ``serving.request_retry`` they are RE-SUBMITTED on a survivor
+        # from scratch (greedy decode is deterministic, so the retry's
+        # tokens are identical to what the dead attempt would have
+        # produced) under a bumped attempt epoch — any late result frame
+        # the half-dead worker still manages to push carries the old
+        # epoch and is discarded transport-side, so the request resolves
+        # EXACTLY once. Without retry (or without survivors) each loss
+        # is reported, typed, as before.
         for state in replica.lost_inflight():
-            self.failed.append(state)
-            self._emit(serving_event(
-                "request_failed", self.tick_count,
-                request_id=state.request.request_id,
-                replica=replica.index, reason="replica_quarantined",
-            ))
+            rid = int(state.request.request_id)
+            target = (self._retry_target(now, state.request)
+                      if self.request_retry else None)
+            if target is not None:
+                epoch = self._bump_epoch(rid)
+                self.retried += 1
+                self._emit(serving_event(
+                    "request_retried", self.tick_count,
+                    request_id=rid, replica=replica.index,
+                    epoch=epoch, reason="replica_quarantined",
+                ))
+                # Original arrival time: the lost attempt is latency the
+                # request really experienced — it stays in its TTFT.
+                target.reroute_in(state.request, state.arrival_s,
+                                  epoch=epoch)
+                self.routes[rid] = target.index
+            else:
+                self.failed.append(state)
+                self._emit(serving_event(
+                    "request_failed", self.tick_count,
+                    request_id=rid,
+                    replica=replica.index, reason="replica_quarantined",
+                ))
         # Queued (never admitted) requests lost nothing but time:
         # re-route them through normal dispatch. No shed re-check — the
         # front door already accepted them; if the detour blew their
         # deadline the surviving engine's admit pass drops them there.
+        # The epoch bumps here too: one discipline for every frame the
+        # dead worker might still emit about a request it no longer owns.
         for request, arrival_s in replica.take_queued():
+            rid = int(request.request_id)
+            # Normal dispatch, affinity included, with the same
+            # last-resort draining fallback as the retry path above.
+            target = self._retry_target(now, request)
+            if target is None:
+                # Fleet fully dark: nothing to reroute onto. Typed
+                # failure instead of a RuntimeError out of _pick — the
+                # router object stays usable for replace_replica().
+                state = RequestState(request=request, arrival_s=arrival_s)
+                state.dropped = True
+                self.failed.append(state)
+                self._emit(serving_event(
+                    "request_failed", self.tick_count,
+                    request_id=rid, replica=replica.index,
+                    reason="no_live_replicas",
+                ))
+                continue
             self.rerouted += 1
             self._emit(serving_event(
                 "request_rerouted", self.tick_count,
-                request_id=request.request_id,
+                request_id=rid,
                 replica=replica.index, reason="replica_quarantined",
             ))
-            # Normal dispatch, affinity included: the dead replica's trie
-            # died with it, so the probe only ever sees survivors.
-            target = self._pick(self.clock(), request)
             # Straight into the target's scheduler with the ORIGINAL
             # arrival time: the detour's queueing is real latency the
             # request experienced and must stay in its TTFT.
-            target.reroute_in(request, arrival_s)
-            self.routes[int(request.request_id)] = target.index
+            target.reroute_in(request, arrival_s,
+                              epoch=self._bump_epoch(rid))
+            self.routes[rid] = target.index
         replica.close()
 
     # ------------------------------------------------------------------
@@ -860,6 +1030,47 @@ class ReplicaRouter:
         r.start_drain()
         self._emit(event_record(
             "replica_draining", self.tick_count, replica=index,
+        ))
+
+    def quarantine_replica(self, index: int, exc: Exception) -> None:
+        """Externally-detected death (the fleet supervisor sees a child
+        exit or kills a hung process): run the SAME quarantine path a
+        step fault takes — retry/reroute the dead worker's work, close
+        its socket. Idempotent on an already-quarantined replica."""
+        r = self.replicas[index]
+        if not r.quarantined:
+            self._quarantine(r, exc)
+
+    def replace_replica(self, index: int, transport) -> None:
+        """Swap a quarantined replica's slot for a freshly-connected
+        transport (the supervisor's restart rejoin). The slot keeps its
+        index — routes, telemetry stamping and dispatch tie-breaks all
+        key on it — and the replacement starts live, so the next
+        dispatch can route to it immediately."""
+        old = self.replicas[index]
+        if not old.quarantined:
+            raise RuntimeError(
+                f"replace_replica({index}): replica is live — quarantine "
+                "it first (replacing a serving replica would strand its "
+                "ledger)"
+            )
+        if int(transport.index) != int(index):
+            raise ValueError(
+                f"replace_replica({index}): transport carries index "
+                f"{transport.index}"
+            )
+        # Results the dead replica delivered BEFORE it died are real
+        # completed work — finished() walks self.replicas, so they must
+        # move into the replacement's ledger or the swap would silently
+        # un-complete them.
+        harvest = getattr(old, "_results", None)
+        if harvest and hasattr(transport, "_results"):
+            for rid, state in harvest.items():
+                transport._results.setdefault(rid, state)
+        old.close()
+        self.replicas[index] = transport
+        self._emit(event_record(
+            "replica_replaced", self.tick_count, replica=index,
         ))
 
     # ------------------------------------------------------------------
@@ -895,12 +1106,24 @@ class ReplicaRouter:
         return self.finished()
 
     def finished(self) -> list[RequestState]:
-        out = []
+        by_rid: dict[int, RequestState] = {}
+        dups = 0
         for r in self.replicas:
             # A quarantined replica's COMPLETED requests were delivered
             # before it died — they count.
-            out.extend(r.finished_states())
-        return sorted(out, key=lambda s: s.request.request_id)
+            for state in r.finished_states():
+                rid = int(state.request.request_id)
+                if rid in by_rid:
+                    # Two replicas both completed one request — the
+                    # double delivery the epoch discipline prevents.
+                    # Keep the routed owner's copy, count the breach.
+                    dups += 1
+                    if self.routes.get(rid) == r.index:
+                        by_rid[rid] = state
+                else:
+                    by_rid[rid] = state
+        self.duplicate_deliveries = dups
+        return [by_rid[rid] for rid in sorted(by_rid)]
 
     def gauges(self) -> list[dict]:
         """Fresh per-replica gauges (one router-tick snapshot)."""
@@ -920,7 +1143,15 @@ class ReplicaRouter:
             "shed_policy": self.shed_policy,
             "shed": len(self.shed),
             "rerouted": self.rerouted,
+            "retried": self.retried,
             "failed": len(self.failed),
+            "duplicate_deliveries": self.duplicate_deliveries,
+            "stale_frames": sum(
+                getattr(r, "stale_frames", 0) for r in self.replicas
+            ),
+            "stale_heartbeats": sum(
+                getattr(r, "stale_heartbeats", 0) for r in self.replicas
+            ),
             "quarantined": [
                 {"replica": r.index, "error": r.error}
                 for r in self.replicas if r.quarantined
@@ -950,6 +1181,9 @@ class ReplicaRouter:
         ``fn(index) -> clock``) gives each replica its OWN clock: the
         virtual-time N-chip simulation in tools/serve_bench.py."""
         self.clock = clock
+        # The sweep's pause detector must not read a timebase swap as a
+        # 15-minute router stall (or as instant staleness).
+        self._last_sweep_s = None
         for r in self.replicas:
             r.set_engine_clock(
                 per_replica(r.index) if per_replica is not None else clock
@@ -981,6 +1215,35 @@ class ReplicaRouter:
             r.close()
 
 
+def dial_worker(index: int, host: str, port: int, *,
+                clock=time.monotonic,
+                connect_timeout_s: float = 60.0) -> SocketReplica:
+    """Dial ONE worker endpoint (bounded connect retry + backoff — a
+    just-bound or just-restarted worker can refuse the first SYN), run
+    the hello handshake, and return the :class:`SocketReplica`. Shared
+    by fleet bring-up and the supervisor's restart re-dial."""
+    sock = net.connect_with_retry(
+        host, int(port), deadline_s=connect_timeout_s
+    )
+    sock.setblocking(False)
+    try:
+        decoder = net.FrameDecoder()
+        frames = net.recv_frames_blocking(
+            sock, decoder, timeout_s=connect_timeout_s
+        )
+        hello = frames[0]
+        if hello.get("type") != "hello":
+            raise net.ProtocolError(
+                f"worker {index} opened with {hello.get('type')!r}, "
+                "expected 'hello'"
+            )
+    except Exception:
+        sock.close()
+        raise
+    return SocketReplica(index, sock, hello, clock=clock,
+                         decoder=decoder, backlog=frames[1:])
+
+
 def connect_fleet(cfg, endpoints, *, clock=time.monotonic, emit=None,
                   connect_timeout_s: float = 60.0) -> ReplicaRouter:
     """Dial a list of ``(host, port)`` worker endpoints, run the hello
@@ -989,32 +1252,11 @@ def connect_fleet(cfg, endpoints, *, clock=time.monotonic, emit=None,
     shedding, draining and quarantine all run the exact in-process code
     paths on pushed state. ``cfg`` is the ``ServingConfig`` the workers
     were launched with (policy/shed/heartbeat knobs must agree)."""
-    import socket as _socket
-
-    transports = []
-    for i, (host, port) in enumerate(endpoints):
-        sock = _socket.create_connection(
-            (host, int(port)), timeout=connect_timeout_s
-        )
-        sock.setblocking(False)
-        try:
-            decoder = net.FrameDecoder()
-            frames = net.recv_frames_blocking(
-                sock, decoder, timeout_s=connect_timeout_s
-            )
-            hello = frames[0]
-            if hello.get("type") != "hello":
-                raise net.ProtocolError(
-                    f"worker {i} opened with {hello.get('type')!r}, "
-                    "expected 'hello'"
-                )
-        except Exception:
-            sock.close()
-            raise
-        transports.append(
-            SocketReplica(i, sock, hello, clock=clock, decoder=decoder,
-                          backlog=frames[1:])
-        )
+    transports = [
+        dial_worker(i, host, port, clock=clock,
+                    connect_timeout_s=connect_timeout_s)
+        for i, (host, port) in enumerate(endpoints)
+    ]
     return ReplicaRouter(
         None, None, cfg, clock=clock, emit=emit, transports=transports,
     )

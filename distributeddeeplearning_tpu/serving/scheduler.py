@@ -1181,6 +1181,14 @@ class Scheduler:
             g["evictable_blocks"] = self.pool.evictable_blocks
             g["spilled_blocks"] = self.pool.spilled_blocks
         if now is not None:
+            # Completion counters ride with every clocked gauge push so a
+            # fleet supervisor can account served-vs-lost work from
+            # heartbeats alone (serving/fleet_supervisor.py) — a dead
+            # worker's last heartbeat tells the router how much it had
+            # finished. Clock-less calls keep the original four-gauge
+            # shape (metrics.serving_gauges back-compat).
+            g["finished"] = len(self.finished)
+            g["dropped"] = len(self.dropped)
             g["oldest_queued_age_s"] = (
                 now - self.pending[0].arrival_s if self.pending else 0.0
             )

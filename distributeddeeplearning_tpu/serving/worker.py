@@ -46,7 +46,7 @@ import socket
 import sys
 import time
 
-from ..supervisor import EXIT_PREEMPTED
+from ..supervisor import EXIT_FAULT, EXIT_PREEMPTED
 from ..telemetry import NULL_TELEMETRY
 from . import net
 from .router import request_from_wire, state_to_wire
@@ -54,6 +54,32 @@ from .router import request_from_wire, state_to_wire
 #: Heartbeat digest-summary cap: enough for every realistic trie on the
 #: CPU sim; bounds the heartbeat frame regardless of pool size.
 DIGEST_SUMMARY_LIMIT = 512
+
+#: Respawn-attempt counter the fleet supervisor stamps on a restarted
+#: worker's environment (mirrors supervisor.ATTEMPT_ENV for training):
+#: one-shot injected faults are armed ONLY at attempt 0, so a restarted
+#: worker never re-fires the fault that killed its predecessor.
+ATTEMPT_ENV = "DDL_WORKER_ATTEMPT"
+#: Which replica index the ``serving.fault_injection`` spec arms
+#: (default 0) — chaos targets ONE worker, the rest stay healthy.
+FAULT_WORKER_ENV = "DDL_SERVE_FAULT_WORKER"
+
+
+def armed_fault(scfg, replica_index: int, env=None):
+    """The ``(kind, step)`` this process should fire, or None. Armed iff
+    a fault spec is set, this is the targeted replica index, and this is
+    the worker's FIRST attempt (``DDL_WORKER_ATTEMPT`` unset/0)."""
+    from .engine import parse_fault_injection
+
+    env = os.environ if env is None else env
+    fault = parse_fault_injection(getattr(scfg, "fault_injection", ""))
+    if fault is None:
+        return None
+    if int(env.get(ATTEMPT_ENV) or 0) != 0:
+        return None
+    if int(env.get(FAULT_WORKER_ENV) or 0) != int(replica_index):
+        return None
+    return fault
 
 
 def check_fleet_composition(cfg, fleet: int, *,
@@ -126,7 +152,10 @@ class ReplicaWorker:
                  heartbeat_interval_s: float = 0.05,
                  shed_percentile: float = 50.0,
                  digest_limit: int = DIGEST_SUMMARY_LIMIT,
-                 telemetry=NULL_TELEMETRY, step_dwell_s: float = 0.0):
+                 telemetry=NULL_TELEMETRY, step_dwell_s: float = 0.0,
+                 fault=None, exit_hook=None,
+                 spill_store: str | None = None,
+                 spill_checkpoint_every_s: float = 0.0):
         self.engine = engine
         self.conn = conn
         self.index = int(replica_index)
@@ -143,10 +172,30 @@ class ReplicaWorker:
         self._last_hb_s: float | None = None
         self._hb_seq = 0
         self.last_ack_seq = -1
-        self._admit_sent: set[int] = set()
-        self._result_sent: set[int] = set()
+        # Lifecycle dedup keyed by (request_id, epoch): a retried
+        # request is a NEW attempt and gets fresh admitted/result frames
+        # stamped with its epoch (the router discards mismatches).
+        self._admit_sent: set[tuple[int, int]] = set()
+        self._result_sent: set[tuple[int, int]] = set()
+        self._epochs: dict[int, int] = {}
         self._poll_cursor: dict[int, int] = {}
         self._peer_gone = False
+        # One-shot injected fault (``armed_fault``): fired from pump()
+        # once ``_steps_done`` reaches the spec's step. ``exit_hook`` is
+        # injectable so in-process tests observe worker_crash without
+        # losing the interpreter.
+        self.fault = tuple(fault) if fault else None
+        self.exit_hook = exit_hook if exit_hook is not None else os._exit
+        self.hung = False
+        self.hb_stalled = False
+        self._steps_done = 0
+        # Spill-tier persistence (engine.save_spill_store): periodic at
+        # ``spill_checkpoint_every_s`` + forced on clean drain. The file
+        # is what a RESTARTED worker re-warms from.
+        self.spill_store = spill_store
+        self.spill_checkpoint_every_s = float(spill_checkpoint_every_s)
+        self._last_ckpt_s: float | None = None
+        self.spill_checkpoints = 0
 
     # -- outbound ---------------------------------------------------------
 
@@ -155,7 +204,7 @@ class ReplicaWorker:
             return
         try:
             net.send_frame(self.conn, obj)
-        except OSError:
+        except (OSError, net.ProtocolError):
             # Router hung up mid-push: frames become best-effort; the
             # pump loop converts this into the drain-and-exit path.
             self._peer_gone = True
@@ -182,6 +231,8 @@ class ReplicaWorker:
     def heartbeat(self, force: bool = False) -> bool:
         """Push gauges + digest summary + shed-estimate percentiles when
         ``heartbeat_interval_s`` has elapsed (or ``force``)."""
+        if self.hb_stalled:
+            return False
         now = self.clock()
         if (not force and self._last_hb_s is not None
                 and now - self._last_hb_s < self.heartbeat_interval_s):
@@ -209,16 +260,19 @@ class ReplicaWorker:
         submitted id or the fleet never reads idle."""
         for state in self.engine.scheduler.active:
             rid = int(state.request.request_id)
-            if rid not in self._admit_sent:
-                self._admit_sent.add(rid)
+            epoch = self._epochs.get(rid, 0)
+            if (rid, epoch) not in self._admit_sent:
+                self._admit_sent.add((rid, epoch))
                 self._send({"type": "admitted", "request_id": rid,
-                            "t_s": state.admit_s})
+                            "epoch": epoch, "t_s": state.admit_s})
         for state in list(self.engine.scheduler.finished) + list(
                 self.engine.scheduler.dropped):
             rid = int(state.request.request_id)
-            if rid not in self._result_sent:
-                self._result_sent.add(rid)
+            epoch = self._epochs.get(rid, 0)
+            if (rid, epoch) not in self._result_sent:
+                self._result_sent.add((rid, epoch))
                 self._send({"type": "result", "request_id": rid,
+                            "epoch": epoch,
                             "state": state_to_wire(state)})
 
     # -- inbound ----------------------------------------------------------
@@ -227,10 +281,23 @@ class ReplicaWorker:
         op = msg.get("op")
         if op == "submit":
             request = request_from_wire(msg["request"])
-            try:
-                self.engine.submit(
-                    request, float(msg.get("arrival_s", self.clock()))
+            if request.request_id is not None:
+                self._epochs[int(request.request_id)] = int(
+                    msg.get("epoch", 0)
                 )
+            try:
+                if msg.get("reroute"):
+                    # Quarantine-displaced work the router already
+                    # accepted: straight into the scheduler, bypassing
+                    # the draining front-door check (mirrors the
+                    # in-process Replica.reroute_in).
+                    self.engine.scheduler.submit(
+                        request, float(msg.get("arrival_s", self.clock()))
+                    )
+                else:
+                    self.engine.submit(
+                        request, float(msg.get("arrival_s", self.clock()))
+                    )
             except Exception as exc:  # noqa: BLE001 — report, don't die
                 self._send({
                     "type": "submit_error",
@@ -275,6 +342,61 @@ class ReplicaWorker:
             self.engine.drain()
         self._exit_when_idle = EXIT_PREEMPTED
 
+    # -- fault injection (serving.fault_injection; chaos harness) ---------
+
+    def _maybe_fault(self) -> None:
+        """Fire the armed one-shot fault once the engine has run the
+        spec's step count. worker_crash exits hard (no drain, no flush —
+        that is the point); worker_hang freezes the loop with the
+        process alive (the stale-heartbeat detection target); conn_drop
+        severs the router socket (the EOF/RST detection target);
+        heartbeat_stall keeps SERVING while going silent — the half-dead
+        worker whose late result frames the epoch check discards."""
+        if self.fault is None:
+            return
+        kind, step = self.fault
+        if self._steps_done < step:
+            return
+        self.fault = None
+        print(json.dumps({
+            "event": "fault_injected", "kind": kind,
+            "replica": self.index, "step": self._steps_done,
+        }), flush=True)
+        if kind == "worker_crash":
+            self.exit_hook(EXIT_FAULT)
+        elif kind == "worker_hang":
+            self.hung = True
+        elif kind == "conn_drop":
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+        elif kind == "heartbeat_stall":
+            self.hb_stalled = True
+
+    # -- spill-tier persistence -------------------------------------------
+
+    def checkpoint_spill(self, force: bool = False) -> bool:
+        """Persist the engine's host spill tier to ``spill_store`` when
+        the periodic cadence has elapsed (or ``force``, the clean-drain
+        path). A crash skips this by definition — the LAST periodic file
+        is what the restarted worker re-warms from."""
+        if not self.spill_store or not getattr(
+                self.engine, "spill_blocks", 0):
+            return False
+        now = self.clock()
+        if not force:
+            if not self.spill_checkpoint_every_s:
+                return False
+            if (self._last_ckpt_s is not None
+                    and now - self._last_ckpt_s
+                    < self.spill_checkpoint_every_s):
+                return False
+        self._last_ckpt_s = now
+        self.engine.save_spill_store(self.spill_store)
+        self.spill_checkpoints += 1
+        return True
+
     # -- the loop body ----------------------------------------------------
 
     def pump(self) -> bool:
@@ -283,6 +405,11 @@ class ReplicaWorker:
         settle the exit once draining completes. Returns True while
         anything moved (the caller selects on the socket when False)."""
         if self.exit_code is not None:
+            return False
+        self._maybe_fault()
+        if self.hung:
+            # Wedged: no reads, no steps, no heartbeats — the process
+            # stays alive until the supervisor's stale-heartbeat kill.
             return False
         busy = False
         try:
@@ -304,10 +431,12 @@ class ReplicaWorker:
             self.handle(msg)
         if not self.engine.scheduler.idle:
             busy = self.engine.step() or busy
+            self._steps_done += 1
             self._sync_lifecycle()
             if self.step_dwell_s:
                 self.sleep(self.step_dwell_s)
         self.heartbeat()
+        self.checkpoint_spill()
         if (self._exit_when_idle is not None
                 and self.engine.scheduler.idle):
             self._finish(self._exit_when_idle)
@@ -315,13 +444,15 @@ class ReplicaWorker:
 
     def _finish(self, code: int) -> None:
         self._sync_lifecycle()
+        self.checkpoint_spill(force=True)
         try:
             self._send({
                 "type": "goodbye",
                 "exit": code,
+                "spill_checkpoints": self.spill_checkpoints,
                 "stats": _jsonable(self.engine.stats()),
             })
-        except OSError:
+        except (OSError, net.ProtocolError):
             pass
         self.telemetry.write_trace()
         self.exit_code = code
@@ -349,6 +480,11 @@ def serve_forever(worker: ReplicaWorker, *,
         busy = worker.pump()
         if worker.exit_code is not None:
             break
+        if worker.hung:
+            # No reads, no work — just stay alive (and cheap) until the
+            # supervisor kills the process.
+            worker.sleep(0.05)
+            continue
         if not busy and worker.engine.scheduler.idle:
             timeout = io_wait_s
             if worker.heartbeat_interval_s:
@@ -396,7 +532,7 @@ def _build_from_config(config_path: str, overrides: list[str]):
     from .engine import check_serving_composition
 
     cfg = apply_overrides(load_config(config_path), overrides)
-    check_serving_composition(cfg)
+    check_serving_composition(cfg, fleet=1)
     mesh, model, trainer, dataset = build_all(cfg)
     vocab = getattr(model, "vocab_size", 0)
     if vocab != 256:
@@ -464,6 +600,15 @@ def main(argv=None) -> int:
     p.add_argument("--dwell-s", type=float, default=0.0,
                    help="sleep this long after every engine step — the "
                    "CPU sim's device-latency stand-in (bench only)")
+    p.add_argument("--spill-store", default=None,
+                   help="spill-tier persistence file: loaded on boot if "
+                   "it exists (the restart re-warm), written on the "
+                   "serving.spill_checkpoint_every_s cadence + on clean "
+                   "drain")
+    p.add_argument("--constrain-pool", type=int, default=0,
+                   help="shrink the device pool to N blocks after "
+                   "warmup (chaos/bench hook: forces real spill "
+                   "pressure on the CPU sim's small traces)")
     p.add_argument("--oracle", action="store_true",
                    help="no socket: run the stdin request list on one "
                    "engine directly and print the token map (the fleet "
@@ -499,6 +644,14 @@ def main(argv=None) -> int:
     engine = ServingEngine(model, params, scfg, seed=args.seed,
                            telemetry=tel)
     engine.warmup()
+    if args.constrain_pool:
+        engine.constrain_pool(args.constrain_pool)
+    rewarm_chains = 0
+    if args.spill_store and os.path.exists(args.spill_store):
+        # The restart re-warm: adopt the previous attempt's persisted
+        # host tier so this worker rejoins with its prefix cache warm.
+        rewarm_chains = engine.load_spill_store(args.spill_store)
+    attempt = int(os.environ.get(ATTEMPT_ENV) or 0)
 
     lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -511,6 +664,8 @@ def main(argv=None) -> int:
         "host": args.host,
         "port": port,
         "pid": os.getpid(),
+        "attempt": attempt,
+        "spill_rewarm_chains": rewarm_chains,
         "num_compiles": engine.num_compiles,
     }), flush=True)
 
@@ -548,6 +703,11 @@ def main(argv=None) -> int:
         shed_percentile=scfg.shed_percentile,
         telemetry=tel,
         step_dwell_s=args.dwell_s,
+        fault=armed_fault(scfg, args.replica_index),
+        spill_store=args.spill_store,
+        spill_checkpoint_every_s=getattr(
+            scfg, "spill_checkpoint_every_s", 0.0
+        ),
     )
     signal.signal(signal.SIGTERM, lambda *_: worker.on_sigterm())
     worker.start()

@@ -799,6 +799,7 @@ class Telemetry:
         self.events: deque = deque(maxlen=int(flight_last))
         self.ledger = None
         self.hists: dict[str, LatencyHistogram] = {}
+        self.counters: dict[str, int] = {}
         self._gauge_last: dict = {}
         self._gauge_max: dict = {}
         self._gauge_samples = 0
@@ -896,6 +897,14 @@ class Telemetry:
         if self.enabled:
             self.events.append(record)
 
+    def count(self, name: str, n: int = 1) -> None:
+        """Bump a named monotonic counter (worker_restarts,
+        requests_retried, stale_frames, ...) — the resilience tallies the
+        fleet report reads from the stats record without replaying the
+        event stream."""
+        if self.enabled:
+            self.counters[name] = self.counters.get(name, 0) + int(n)
+
     def record_exe(self, name: str, compiled=None, **kw) -> None:
         if self.enabled:
             self.registry.record(name, compiled, **kw)
@@ -955,6 +964,7 @@ class Telemetry:
             "attempt": self.attempt,
             "histograms": {k: h.to_dict()
                            for k, h in sorted(self.hists.items())},
+            "counters": dict(sorted(self.counters.items())),
             "gauges": {
                 "samples": self._gauge_samples,
                 "last": dict(self._gauge_last),

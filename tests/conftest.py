@@ -92,6 +92,13 @@ def pytest_configure(config):
         "markers",
         "interpret: Pallas kernel parity via the interpret-mode evaluator",
     )
+    # Fault-injection runs that spawn real worker subprocesses
+    # (tools/serve_chaos.py). Selectable as `-m chaos`; the full matrix
+    # lives outside tier-1, but a shrunken env-gated smoke rides along.
+    config.addinivalue_line(
+        "markers",
+        "chaos: serving fault-injection harness (worker subprocesses)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -34,12 +34,15 @@ def _run_report(src_dir):
 
 def _check_schema(rec):
     assert rec["schema_version"] == 1
-    assert rec["source_glob"] == "BENCH_*.json + FLEET.json"
+    assert rec["source_glob"] == (
+        "BENCH_*.json + FLEET.json + SERVE_CHAOS_STATUS.json"
+    )
     assert isinstance(rec["artifacts"], dict)
     assert isinstance(rec["unreadable"], dict)
     for name, entry in rec["artifacts"].items():
         assert name.endswith(".json")
-        assert name.startswith("BENCH_") or name == "FLEET.json"
+        assert name.startswith("BENCH_") or name in (
+            "FLEET.json", "SERVE_CHAOS_STATUS.json")
         assert set(entry) == {"utc", "keys", "headline"}
         assert isinstance(entry["keys"], list)
         assert isinstance(entry["headline"], dict)
@@ -62,13 +65,38 @@ def test_report_on_synthetic_corpus(tmp_path):
          "headline": {"pod_goodput_fraction": 0.42,
                       "max_step_skew_s": 0.003}}
     ))
+    # SERVE_CHAOS_STATUS.json rides along too: the self-healing fleet's
+    # chaos headline (tools/serve_chaos.py shape).
+    (tmp_path / "SERVE_CHAOS_STATUS.json").write_text(json.dumps(
+        {"utc": "2026-01-01T00:00:00Z", "bench": "serve_chaos",
+         "kinds": ["worker_crash", "worker_hang"], "ok": True,
+         "runs": [
+             {"run": "worker_crash", "ok": True, "token_parity": True,
+              "duplicate_deliveries": 0,
+              "restart_records": [
+                  {"recovery_s": 11.25, "spill_rewarm_chains": 4}]},
+             {"run": "worker_hang", "ok": True, "token_parity": True,
+              "duplicate_deliveries": 0,
+              "restart_records": [
+                  {"recovery_s": 9.5, "spill_rewarm_chains": 7}]},
+         ]}
+    ))
     rec = _run_report(tmp_path)
     _check_schema(rec)
     assert set(rec["artifacts"]) == {
-        "BENCH_A.json", "BENCH_B.json", "FLEET.json"}
+        "BENCH_A.json", "BENCH_B.json", "FLEET.json",
+        "SERVE_CHAOS_STATUS.json"}
     fleet = rec["artifacts"]["FLEET.json"]["headline"]
     assert fleet["pod_goodput_fraction"] == 0.42
     assert fleet["max_step_skew_s"] == 0.003
+    chaos = rec["artifacts"]["SERVE_CHAOS_STATUS.json"]["headline"]
+    assert chaos["chaos_all_green"] is True
+    assert chaos["chaos_runs_green"] == 2
+    assert chaos["chaos_fault_kinds"] == 2
+    assert chaos["chaos_duplicate_deliveries"] == 0
+    assert chaos["chaos_token_parity"] is True
+    assert chaos["chaos_max_recovery_s"] == 11.25
+    assert chaos["chaos_max_rewarm_chains"] == 7
     a = rec["artifacts"]["BENCH_A.json"]
     assert a["headline"]["steps_per_sec"] == 12.5
     assert a["headline"]["n_rows"] == 2

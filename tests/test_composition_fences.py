@@ -887,3 +887,82 @@ def test_fleet_legal_compositions_pass(fleet, kwargs):
     from distributeddeeplearning_tpu.serving import check_fleet_composition
 
     check_fleet_composition(ServingConfig(**kwargs), fleet)  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# Self-healing fleet fence matrix (restart budget x backoff x fault DSL)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kwargs,fleet,err,match", [
+    # restart budget: negatives name the knob (0 is legal = never restart)
+    (dict(max_worker_restarts=-1), 2, ValueError,
+     "max_worker_restarts"),
+    # backoff shape: base must be positive and <= cap
+    (dict(restart_backoff_base_s=0.0), 2, ValueError,
+     "restart_backoff"),
+    (dict(restart_backoff_base_s=2.0, restart_backoff_max_s=1.0), 2,
+     ValueError, "restart_backoff"),
+    # checkpoint cadence: negative, and cadence without a spill tier
+    (dict(spill_checkpoint_every_s=-0.5), 2, ValueError,
+     "spill_checkpoint_every_s"),
+    (dict(spill_checkpoint_every_s=1.0, spill_blocks=0), 2, ValueError,
+     "spill_checkpoint_every_s"),
+    # fault DSL: unknown kinds and malformed steps die at config time
+    (dict(fault_injection="oom:3"), 2, ValueError, "fault_injection"),
+    (dict(fault_injection="worker_crash"), 2, ValueError,
+     "expected '<kind>:K'"),
+    (dict(fault_injection="worker_crash:-1"), 2, ValueError,
+     "expected '<kind>:K'"),
+    (dict(fault_injection="worker_hang:two"), 2, ValueError,
+     "expected '<kind>:K'"),
+    # fault injection x in-process serve: no worker process to kill
+    (dict(fault_injection="worker_crash:5"), 0, NotImplementedError,
+     "in-process"),
+])
+def test_fleet_healing_fence_matrix(kwargs, fleet, err, match):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(
+        model=ModelConfig(name="gpt2"),
+        serving=ServingConfig(**kwargs),
+    )
+    with pytest.raises(err, match=match):
+        check_serving_composition(cfg, fleet=fleet)
+
+
+@pytest.mark.parametrize("kwargs,fleet", [
+    # the chaos harness composition: fault x fleet x prefix cache + spill
+    (dict(fault_injection="worker_crash:18", prefix_cache=True,
+          suffix_buckets=(8,), prompt_buckets=(16, 32, 64),
+          spill_blocks=24, spill_checkpoint_every_s=0.05,
+          max_worker_restarts=2), 2),
+    # every fault kind is spec-able
+    (dict(fault_injection="worker_hang:3"), 2),
+    (dict(fault_injection="conn_drop:0"), 2),
+    (dict(fault_injection="heartbeat_stall:7"), 3),
+    # healing knobs alone, in-process: legal (they are simply inert)
+    (dict(max_worker_restarts=5, restart_backoff_base_s=0.1,
+          restart_backoff_max_s=10.0), 0),
+    # budget 0 (quarantine forever) is a legal degraded mode
+    (dict(max_worker_restarts=0), 2),
+    # fault x kv_quant x spill tier: the full hierarchy under chaos
+    (dict(fault_injection="worker_crash:9", prefix_cache=True,
+          suffix_buckets=(8,), prompt_buckets=(16, 32, 64),
+          spill_blocks=16, kv_quant="int8", spill_checkpoint_every_s=0.1),
+     2),
+])
+def test_fleet_healing_legal_pairs_pass(kwargs, fleet):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(
+        model=ModelConfig(name="gpt2"),
+        serving=ServingConfig(**kwargs),
+    )
+    check_serving_composition(cfg, fleet=fleet)  # must not raise

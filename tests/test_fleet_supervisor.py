@@ -1,0 +1,564 @@
+"""Self-healing fleet (serving/fleet_supervisor.py): death detection ×
+classification, backoff-scheduled respawn with KV spill re-warm,
+at-most-once retry semantics under attempt epochs, quarantine × drain ×
+restart interleavings, and duplicate/late-frame discard — all fake-clock
+deterministic over socketpairs, no subprocesses."""
+
+import os
+import socket
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributeddeeplearning_tpu import models
+from distributeddeeplearning_tpu.config import ServingConfig
+from distributeddeeplearning_tpu.serving import (
+    FleetSupervisor,
+    Request,
+    ReplicaRouter,
+    ServingEngine,
+    SocketReplica,
+)
+from distributeddeeplearning_tpu.serving import net
+from distributeddeeplearning_tpu.serving.fleet_supervisor import (
+    TERM_GRACE_S,
+    WorkerHandle,
+)
+from distributeddeeplearning_tpu.serving.worker import ReplicaWorker
+from distributeddeeplearning_tpu.supervisor import (
+    CRASH,
+    EXIT_FAULT,
+    EXIT_PREEMPTED,
+    HANG,
+)
+from distributeddeeplearning_tpu.telemetry import NULL_TELEMETRY
+
+_CFG = ServingConfig(
+    slots=3, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+    prompt_buckets=(8, 16), heartbeat_interval_s=0.5,
+    heartbeat_timeout_s=2.0, request_retry=True,
+    max_worker_restarts=2, restart_backoff_base_s=0.5,
+    restart_backoff_max_s=4.0,
+)
+
+
+def _model_and_params(seed=7):
+    model = models.get_model("gpt2", size="tiny", vocab_size=97,
+                             max_len=64)
+    params = model.init(
+        jax.random.PRNGKey(seed), np.zeros((1, 8), np.int32)
+    )["params"]
+    return model, params
+
+
+def _prompts(lens, seed=42):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(1, 97, n))) for n in lens]
+
+
+def _cell_clock(t0=100.0):
+    t = [t0]
+    return t, (lambda: t[0])
+
+
+def _reference(model, params, prompts, max_new=9):
+    eng = ServingEngine(model, params, ServingConfig(**{
+        **vars(_CFG), "heartbeat_timeout_s": 0.0,
+    }))
+    for j, p in enumerate(prompts):
+        eng.submit(Request(prompt=list(p), max_new_tokens=max_new,
+                           request_id=j))
+    return {s.request.request_id: list(s.generated) for s in eng.run()}
+
+
+class FakeProc:
+    """A Popen stand-in whose exit the test scripts by setting ``rc``;
+    terminate()/kill() are recorded, not delivered."""
+
+    def __init__(self):
+        self.rc = None
+        self.signals = []
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.signals.append("term")
+
+    def kill(self):
+        self.signals.append("kill")
+
+
+class Fleet:
+    """The whole self-healing stack in-process on a fake clock:
+    ReplicaWorkers over socketpairs, a router of SocketReplica
+    transports, and a FleetSupervisor whose spawn/dial hooks mint fresh
+    worker+transport pairs (optionally re-warming a spill store)."""
+
+    def __init__(self, n, cfg, clock, t, *, model=None, params=None,
+                 spill_dir=None):
+        if model is None:
+            model, params = _model_and_params()
+        self.model, self.params, self.cfg = model, params, cfg
+        self.clock, self.t = clock, t
+        self.spill_dir = spill_dir
+        self.workers = {}
+        self.procs = [FakeProc() for _ in range(n)]
+        transports = [self._mint(i)[1] for i in range(n)]
+        self.router = ReplicaRouter(None, None, cfg, clock=clock,
+                                    transports=transports)
+        self.sup = FleetSupervisor(
+            self.router, self.procs, self._spawn, cfg,
+            dial=self._dial, clock=clock,
+        )
+        self._pending_transport = None
+
+    def _spill_path(self, i):
+        if self.spill_dir is None:
+            return None
+        return os.path.join(self.spill_dir, f"spill_w{i}.json")
+
+    def _mint(self, i, attempt=0):
+        """One fresh worker + connected transport, the way a real spawn
+        boots one: warmup, then re-warm from the spill store if present."""
+        router_side, worker_side = socket.socketpair()
+        router_side.setblocking(False)
+        worker_side.setblocking(False)
+        engine = ServingEngine(self.model, self.params, self.cfg,
+                               clock=self.clock)
+        engine.warmup()
+        rewarm = 0
+        store = self._spill_path(i)
+        if store and os.path.exists(store) and getattr(
+                engine, "spill_blocks", 0):
+            rewarm = engine.load_spill_store(store)
+        w = ReplicaWorker(
+            engine, worker_side, replica_index=i, clock=self.clock,
+            sleep=lambda s: None,
+            heartbeat_interval_s=self.cfg.heartbeat_interval_s,
+            telemetry=NULL_TELEMETRY,
+            spill_store=store,
+            spill_checkpoint_every_s=getattr(
+                self.cfg, "spill_checkpoint_every_s", 0.0),
+        )
+        w.start()
+        dec = net.FrameDecoder()
+        frames = net.recv_available(router_side, dec) or []
+        assert frames and frames[0]["type"] == "hello"
+        transport = SocketReplica(
+            i, router_side, frames[0], clock=self.clock, decoder=dec,
+            backlog=frames[1:],
+        )
+        self.workers[i] = w
+        self._last_rewarm = rewarm
+        return w, transport
+
+    def _spawn(self, index, attempt):
+        proc = FakeProc()
+        self.procs[index] = proc
+        _, transport = self._mint(index, attempt)
+        self._pending_transport = transport
+        return proc, {
+            "host": "fake", "port": 0,
+            "spill_rewarm_chains": self._last_rewarm,
+        }
+
+    def _dial(self, index, host, port):
+        transport, self._pending_transport = self._pending_transport, None
+        return transport
+
+    def kill_worker(self, i, rc, *, close=True):
+        """Script a worker death: the process 'exits' with ``rc`` and
+        (by default) its socket drops — the EOF the router's pump sees."""
+        w = self.workers[i]
+        w.exit_code = rc if w.exit_code is None else w.exit_code
+        if close:
+            w.conn.close()
+        self.procs[i].rc = rc
+
+    def drive(self, *, dt=0.01, max_iters=5000, until=None):
+        for _ in range(max_iters):
+            self.t[0] += dt
+            for i, w in list(self.workers.items()):
+                if w.exit_code is None:
+                    w.pump()
+            self.router.step()
+            self.sup.tick()
+            if until is not None and until():
+                return None
+            if (until is None and self.router.idle
+                    and not self.sup.pending_recovery):
+                return self.router.finished()
+        raise AssertionError("fleet never converged")
+
+
+# ---------------------------------------------------------------------------
+# Crash -> backoff -> respawn -> retry: token-identical under the oracle
+# ---------------------------------------------------------------------------
+
+
+def test_crash_restart_retries_inflight_token_identically():
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9, 3, 12, 7, 4))
+    ref = _reference(model, params, prompts)
+    t, clock = _cell_clock()
+    fleet = Fleet(2, _CFG, clock, t, model=model, params=params)
+    for j, p in enumerate(prompts):
+        fleet.router.submit(Request(prompt=list(p), max_new_tokens=9,
+                                    request_id=j))
+    # Let work spread + admit, then crash worker 0 mid-flight.
+    fleet.drive(until=lambda: not fleet.router.replicas[0].engine_idle)
+    fleet.kill_worker(0, EXIT_FAULT)
+    fleet.drive(until=lambda: fleet.sup.restarts >= 1)
+    done = fleet.drive()
+    assert len(done) == len(prompts)
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    stats = fleet.router.stats()
+    # At-most-once: nothing double-delivered, nothing lost.
+    assert stats["duplicate_deliveries"] == 0
+    assert stats["failed"] == 0
+    assert stats["retried"] + stats["rerouted"] >= 1
+    sup_stats = fleet.sup.stats()
+    assert sup_stats["restarts"] == 1
+    assert sup_stats["per_worker"][0]["last_kind"] == "fault"
+    names = [e["event"] for e in fleet.sup.events]
+    assert names == ["worker_exit", "worker_restart_scheduled",
+                     "worker_restarted"]
+
+
+def test_restarted_worker_rewarm_from_spill_store(tmp_path):
+    # The KV re-warm chain: worker 0 checkpoints its spill tier, dies,
+    # and its replacement boots with the store's chains restored.
+    cfg = ServingConfig(**{
+        **vars(_CFG), "spill_blocks": 16, "prefix_cache": True,
+        "suffix_buckets": (4,), "spill_checkpoint_every_s": 0.01,
+    })
+    model, params = _model_and_params()
+    t, clock = _cell_clock()
+    fleet = Fleet(2, cfg, clock, t, model=model, params=params,
+                  spill_dir=str(tmp_path))
+    # Seed spill-tier content directly: force chains into worker 0's
+    # host tier, then let the periodic checkpoint persist them.
+    w0 = fleet.workers[0]
+    prompts = _prompts((8, 8, 8), seed=3)
+    for j, p in enumerate(prompts):
+        fleet.router.submit(Request(prompt=list(p), max_new_tokens=4,
+                                    request_id=j))
+    fleet.drive()
+    pool = w0.engine.scheduler.pool
+    if not pool.spilled_blocks:
+        # Make the eviction explicit: demote every evictable block.
+        got = pool.alloc(pool.free_blocks + pool.evictable_blocks)
+        pool.free(got)
+    w0.checkpoint_spill(force=True)
+    assert os.path.exists(tmp_path / "spill_w0.json")
+    fleet.kill_worker(0, EXIT_FAULT)
+    fleet.drive(until=lambda: fleet.sup.restarts >= 1)
+    rec = fleet.sup.restart_records[0]
+    assert rec["replica"] == 0
+    assert rec["spill_rewarm_chains"] > 0
+    assert rec["recovery_s"] >= 0.0
+    fleet.drive()
+
+
+# ---------------------------------------------------------------------------
+# Detection: hang via stale heartbeat -> SIGKILL; EOF -> SIGTERM + grace
+# ---------------------------------------------------------------------------
+
+
+def test_hang_detected_via_stale_heartbeat_and_killed():
+    t, clock = _cell_clock()
+    fleet = Fleet(2, _CFG, clock, t)
+    fleet.router.submit(Request(prompt=[1, 2, 3], max_new_tokens=6,
+                                request_id=0))
+    fleet.workers[0].hung = True
+    fleet.workers[1].hung = True  # park the survivor too: isolate sweep
+    # No pumps advance heartbeats; age the workers past the timeout in
+    # sub-threshold increments — one big jump would read as a ROUTER
+    # pause and be credited back (the sweep is pause-aware: it only
+    # charges silence it actually listened through).
+    step_s = _CFG.heartbeat_timeout_s / 4.0
+    for _ in range(6):
+        t[0] += step_s
+        fleet.router.step()
+    quarantined = [r.index for r in fleet.router.replicas
+                   if r.quarantined]
+    assert quarantined  # the sweep fired
+    fleet.workers[1].hung = False
+    fleet.sup.tick()
+    for i in quarantined:
+        h = fleet.sup.handles[i]
+        assert h.kind_override == HANG
+        assert fleet.procs[i].signals == ["kill"]  # no SIGTERM grace
+        # The 'kill' lands: script the exit like the OS would.
+        fleet.kill_worker(i, -9)
+    fleet.sup.tick()
+    for i in quarantined:
+        assert fleet.sup.handles[i].last_kind == HANG
+        assert fleet.sup.handles[i].respawn_at is not None
+
+
+def test_socket_death_with_live_process_gets_term_then_kill_grace():
+    t, clock = _cell_clock()
+    fleet = Fleet(2, _CFG, clock, t)
+    # Sever worker 0's socket WITHOUT exiting the process, with work
+    # ledgered on it (a clean EOF with an empty ledger is a non-event):
+    # the router pump sees EOF and quarantines; the supervisor must
+    # SIGTERM first (drain contract) and only SIGKILL after the grace
+    # deadline.
+    fleet.router.submit(Request(prompt=[1, 2, 3], max_new_tokens=4,
+                                request_id=0))
+    assert fleet.router.routes[0] == 0  # least_loaded tie -> index 0
+    fleet.workers[0].conn.close()
+    fleet.router.step()
+    assert fleet.router.replicas[0].quarantined
+    fleet.sup.tick()
+    h = fleet.sup.handles[0]
+    assert h.kind_override == CRASH
+    assert fleet.procs[0].signals == ["term"]
+    t[0] += TERM_GRACE_S + 0.1
+    fleet.sup.tick()
+    assert fleet.procs[0].signals == ["term", "kill"]
+
+
+def test_preempted_worker_not_restarted():
+    t, clock = _cell_clock()
+    fleet = Fleet(2, _CFG, clock, t)
+    fleet.kill_worker(0, EXIT_PREEMPTED)
+    fleet.sup.tick()
+    h = fleet.sup.handles[0]
+    assert h.stopped and h.respawn_at is None and not h.gave_up
+    assert [e["event"] for e in fleet.sup.events] == ["worker_exit"]
+
+
+# ---------------------------------------------------------------------------
+# Backoff schedule, budget exhaustion, graceful degradation
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_schedule_doubles_and_caps():
+    t, clock = _cell_clock()
+    fleet = Fleet(1, _CFG, clock, t)
+
+    class NoJitter:
+        def random(self):
+            return 0.0
+
+    fleet.sup._rng = NoJitter()
+    assert fleet.sup.backoff_s(0) == pytest.approx(0.5)
+    assert fleet.sup.backoff_s(1) == pytest.approx(1.0)
+    assert fleet.sup.backoff_s(2) == pytest.approx(2.0)
+    assert fleet.sup.backoff_s(10) == pytest.approx(4.0)  # capped
+
+
+def test_restart_budget_exhaustion_degrades_to_survivors():
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9, 3, 7))
+    ref = _reference(model, params, prompts)
+    cfg = ServingConfig(**{**vars(_CFG), "max_worker_restarts": 0})
+    t, clock = _cell_clock()
+    fleet = Fleet(2, cfg, clock, t, model=model, params=params)
+    for j, p in enumerate(prompts):
+        fleet.router.submit(Request(prompt=list(p), max_new_tokens=9,
+                                    request_id=j))
+    fleet.drive(until=lambda: not fleet.router.replicas[0].engine_idle)
+    fleet.kill_worker(0, EXIT_FAULT)
+    done = fleet.drive()
+    # Budget 0: no respawn, typed give-up, the survivor serves ALL work
+    # token-identically — degradation, not a hung fleet or lost requests.
+    assert fleet.sup.handles[0].gave_up
+    assert fleet.sup.restarts == 0
+    assert "worker_give_up" in [e["event"] for e in fleet.sup.events]
+    assert len(done) == len(prompts)
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    assert fleet.router.stats()["duplicate_deliveries"] == 0
+
+
+def test_respawn_failure_counts_against_budget():
+    t, clock = _cell_clock()
+    cfg = ServingConfig(**{**vars(_CFG), "max_worker_restarts": 1})
+    fleet = Fleet(1, cfg, clock, t)
+
+    def bad_spawn(index, attempt):
+        raise OSError("spawn refused")
+
+    fleet.sup.spawn = bad_spawn
+    fleet.kill_worker(0, EXIT_FAULT)
+    fleet.sup.tick()
+    h = fleet.sup.handles[0]
+    t[0] = h.respawn_at + 0.01
+    fleet.sup.tick()  # spawn fails -> one strike, rescheduled
+    assert h.restarts_done == 1 and h.respawn_at is not None
+    t[0] = h.respawn_at + 0.01
+    fleet.sup.tick()  # second failure -> budget gone -> give up
+    assert h.gave_up
+    names = [e["event"] for e in fleet.sup.events]
+    assert names.count("worker_respawn_failed") == 1
+    assert names.count("worker_give_up") == 1
+
+
+# ---------------------------------------------------------------------------
+# Interleavings: quarantine × drain × restart (the satellite matrix)
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_mid_drain_takeover_token_identical():
+    # Drain replica 0 (intake cut, in-flight finishing), then kill it
+    # MID-DRAIN: its unfinished work must still take over on the
+    # survivor token-identically — drain must not disable recovery.
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9, 3, 12, 7))
+    ref = _reference(model, params, prompts)
+    t, clock = _cell_clock()
+    fleet = Fleet(2, _CFG, clock, t, model=model, params=params)
+    for j, p in enumerate(prompts):
+        fleet.router.submit(Request(prompt=list(p), max_new_tokens=9,
+                                    request_id=j))
+    fleet.drive(until=lambda: not fleet.router.replicas[0].engine_idle)
+    fleet.router.drain(0)
+    fleet.drive(until=lambda: True)  # one tick: drain op delivered
+    fleet.kill_worker(0, EXIT_FAULT)
+    done = fleet.drive()
+    # A draining worker's death is an EXPECTED exit for restart purposes
+    # (it was being retired) — but its work still completes elsewhere.
+    assert fleet.sup.handles[0].stopped
+    assert len(done) == len(prompts)
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    assert fleet.router.stats()["duplicate_deliveries"] == 0
+
+
+def test_restart_during_another_workers_drain():
+    # Drain worker 1 while worker 0 crash-restarts: the respawned
+    # worker 0 must rejoin dispatch (drained 1 is intake-closed), and
+    # everything completes exactly once.
+    model, params = _model_and_params()
+    prompts = _prompts((5, 9, 3, 12, 7, 4))
+    ref = _reference(model, params, prompts)
+    t, clock = _cell_clock()
+    fleet = Fleet(2, _CFG, clock, t, model=model, params=params)
+    for j, p in enumerate(prompts[:4]):
+        fleet.router.submit(Request(prompt=list(p), max_new_tokens=9,
+                                    request_id=j))
+    fleet.drive(until=lambda: not fleet.router.replicas[0].engine_idle)
+    fleet.kill_worker(0, EXIT_FAULT)
+    fleet.router.drain(1)
+    fleet.drive(until=lambda: fleet.sup.restarts >= 1)
+    # Post-restart submissions can only land on the respawned worker 0.
+    for j, p in enumerate(prompts[4:], start=4):
+        fleet.router.submit(Request(prompt=list(p), max_new_tokens=9,
+                                    request_id=j))
+    done = fleet.drive()
+    assert len(done) == len(prompts)
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    late = [fleet.router.routes[j] for j in (4, 5)]
+    assert late == [0, 0]  # the replacement serves, not the drained one
+    assert fleet.router.stats()["duplicate_deliveries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Epochs: duplicate/late result frames are discarded, counted
+# ---------------------------------------------------------------------------
+
+
+def _manual_transport(cfg, clock):
+    """A SocketReplica whose far end the TEST plays by hand — for
+    injecting crafted (stale) frames."""
+    router_side, far = socket.socketpair()
+    router_side.setblocking(False)
+    far.setblocking(False)
+    hello = {"type": "hello", "replica": 0, "block_size": 4, "slots": 3}
+    transport = SocketReplica(0, router_side, hello, clock=clock)
+    return transport, far
+
+
+def test_duplicate_result_old_epoch_discarded_and_counted():
+    t, clock = _cell_clock()
+    transport, far = _manual_transport(_CFG, clock)
+    req = Request(prompt=[1, 2, 3], max_new_tokens=4, request_id=7)
+    transport.submit_request(req, clock(), epoch=0)
+    frames = net.recv_available(
+        far, net.FrameDecoder()
+    )
+    assert frames and frames[-1]["op"] == "submit"
+    assert frames[-1]["epoch"] == 0
+    # The worker half-dies; the router retries rid 7 elsewhere and the
+    # epoch advances. A LATE result frame from the old attempt arrives:
+    net.send_frame(far, {
+        "type": "result", "request_id": 7, "epoch": 0,
+        "state": {"arrival_s": clock(), "generated": [9, 9, 9]},
+    })
+    # Re-arm the transport at the new epoch (as reroute_in would).
+    transport._outstanding[7] = (req, clock(), 1)
+    transport.step()
+    assert 7 not in transport._results  # stale frame dropped
+    assert transport.stale_frames == 1
+    # The CURRENT attempt's result is accepted.
+    net.send_frame(far, {
+        "type": "result", "request_id": 7, "epoch": 1,
+        "state": {"arrival_s": clock(), "generated": [4, 5]},
+    })
+    transport.step()
+    assert 7 in transport._results
+    assert transport.stale_frames == 1
+    assert transport._results[7].generated == [4, 5]
+
+
+def test_finished_dedupes_same_rid_across_replicas():
+    # Backstop below the epoch check: if the same rid somehow completes
+    # in two replicas' ledgers, finished() must deliver it ONCE and
+    # count the duplicate.
+    model, params = _model_and_params()
+    router = ReplicaRouter(model, params, ServingConfig(**{
+        **vars(_CFG), "heartbeat_timeout_s": 0.0, "replicas": 2,
+    }))
+    st = router.submit(Request(prompt=[1, 2, 3], max_new_tokens=3,
+                               request_id=0))
+    router.run()
+    owner = router.routes[0]
+    other = router.replicas[1 - owner]
+    # Forge a duplicate completion on the non-owner.
+    other.engine.scheduler.finished.append(st)
+    done = router.finished()
+    assert [s.request.request_id for s in done] == [0]
+    assert router.duplicate_deliveries == 1
+    assert router.stats()["duplicate_deliveries"] == 1
+
+
+def test_out_of_order_heartbeat_dropped():
+    t, clock = _cell_clock()
+    transport, far = _manual_transport(_CFG, clock)
+    net.send_frame(far, {"type": "heartbeat", "seq": 5, "gauges": {}})
+    transport.step()
+    assert transport.heartbeat_seq == 5
+    seen = transport.last_heartbeat_s
+    t[0] += 1.0
+    # A delayed duplicate (seq 3) arrives late: it must NOT refresh
+    # liveness or regress the gauge stream.
+    net.send_frame(far, {"type": "heartbeat", "seq": 3, "gauges": {}})
+    transport.step()
+    assert transport.heartbeat_seq == 5
+    assert transport.last_heartbeat_s == seen
+    assert transport.stale_heartbeats == 1
+    net.send_frame(far, {"type": "heartbeat", "seq": 6, "gauges": {}})
+    transport.step()
+    assert transport.heartbeat_seq == 6
+    assert transport.last_heartbeat_s > seen
+    assert transport.stale_heartbeats == 1
+
+
+# ---------------------------------------------------------------------------
+# Handle plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_worker_handle_defaults():
+    h = WorkerHandle(3)
+    assert h.supervising and h.attempt == 0 and h.respawn_at is None
+    h.gave_up = True
+    assert not h.supervising

@@ -1,0 +1,141 @@
+"""Chaos-harness contract (tools/serve_chaos.py).
+
+Three legs, by cost:
+
+- ``check_status`` validator on synthetic status dicts — pure dict
+  logic, tier-1 fast lane;
+- ``--check`` against the COMMITTED SERVE_CHAOS_STATUS.json — re-runs
+  the validator over the real artifact, no worker processes;
+- an env-gated live smoke (``DDL_CHAOS_SMOKE=1``, ``-m chaos``) that
+  actually kills a worker subprocess over a shrunken workload — the
+  full matrix stays in tools/serve_chaos.py, outside tier-1.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_REPO, "tools", "serve_chaos.py")
+_ARTIFACT = os.path.join(_REPO, "SERVE_CHAOS_STATUS.json")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("serve_chaos", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _green_status():
+    """Minimal status the validator must accept: every pinned claim
+    holds — per-kind ok, exact accounting, zero duplicates, parity,
+    spill re-warm on every non-exhaustion restart, exhaustion present."""
+    def run(kind, **over):
+        rec = {
+            "run": kind, "ok": True, "submitted": 28, "served": 28,
+            "shed": 0, "dropped": 0, "duplicate_deliveries": 0,
+            "token_parity": True, "checks": {},
+            "restart_records": [
+                {"replica": 0, "attempt": 1, "kind": "fault",
+                 "recovery_s": 10.0, "spill_rewarm_chains": 3},
+            ],
+        }
+        rec.update(over)
+        return rec
+
+    kinds = ["worker_crash", "worker_hang", "conn_drop",
+             "heartbeat_stall"]
+    return {
+        "bench": "serve_chaos", "kinds": kinds, "exhaustion_run": True,
+        "ok": True,
+        "runs": [run(k) for k in kinds] + [
+            run("exhaustion", restart_records=[]),
+        ],
+    }
+
+
+def test_check_status_accepts_green_artifact():
+    mod = _load_tool()
+    assert mod.check_status(_green_status()) == []
+
+
+@pytest.mark.parametrize("mutate, expect", [
+    # A run missing entirely.
+    (lambda s: s["runs"].pop(0), "run missing"),
+    # Per-kind ok=False surfaces its failed check names.
+    (lambda s: (s["runs"][1].update(
+        ok=False, checks={"token_parity": False}),
+    ), "failed checks"),
+    # served + shed + dropped must equal submitted EXACTLY.
+    (lambda s: s["runs"][2].update(served=27), "accounting broken"),
+    # At-most-once: any double delivery is terminal.
+    (lambda s: s["runs"][3].update(duplicate_deliveries=1),
+     "duplicate deliveries"),
+    # Greedy parity vs the undisturbed oracle.
+    (lambda s: s["runs"][0].update(token_parity=False),
+     "token parity broken"),
+    # The restart must have re-warmed from the spill checkpoint.
+    (lambda s: s["runs"][0]["restart_records"][0].update(
+        spill_rewarm_chains=0), "no spill re-warm"),
+    # exhaustion_run promised but absent.
+    (lambda s: s["runs"].pop(), "exhaustion: run missing"),
+    # Aggregate ok must agree.
+    (lambda s: s.update(ok=False), "status.ok is false"),
+])
+def test_check_status_flags_each_broken_claim(mutate, expect):
+    mod = _load_tool()
+    status = _green_status()
+    mutate(status)
+    fails = mod.check_status(status)
+    assert any(expect in f for f in fails), (expect, fails)
+
+
+def test_check_mode_validates_committed_artifact():
+    if not os.path.exists(_ARTIFACT):
+        pytest.skip("SERVE_CHAOS_STATUS.json not yet generated")
+    proc = subprocess.run(
+        [sys.executable, _TOOL, "--check"],
+        capture_output=True, text=True, cwd=_REPO, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.splitlines()[-1])
+    assert rec["check"] == "serve_chaos"
+    assert rec["ok"] is True and rec["failures"] == []
+
+
+@pytest.mark.chaos
+def test_live_chaos_smoke_one_crash(tmp_path):
+    """Shrunken single-kind live run: REAL worker subprocesses, one
+    injected crash, exactly-once + parity + re-warm pins. Opt-in
+    (DDL_CHAOS_SMOKE=1): several minutes of subprocess AOT boots."""
+    if os.environ.get("DDL_CHAOS_SMOKE") != "1":
+        pytest.skip("live chaos smoke is opt-in: set DDL_CHAOS_SMOKE=1")
+    out = tmp_path / "SERVE_CHAOS_STATUS.json"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DDL_CHAOS_KINDS": "worker_crash",
+        "DDL_CHAOS_SKIP_EXHAUSTION": "1",
+        "DDL_CHAOS_OUT": str(out),
+    }
+    proc = subprocess.run(
+        [sys.executable, _TOOL], capture_output=True, text=True,
+        cwd=_REPO, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    status = json.loads(out.read_text())
+    assert status["ok"] is True
+    (run,) = status["runs"]
+    assert run["run"] == "worker_crash"
+    assert run["served"] + run["shed"] + run["dropped"] == \
+        run["submitted"]
+    assert run["duplicate_deliveries"] == 0
+    assert run["token_parity"] is True
+    assert any(r["spill_rewarm_chains"] > 0
+               for r in run["restart_records"])

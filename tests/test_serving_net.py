@@ -109,3 +109,75 @@ def test_digest_hex_codec_round_trip():
     assert digests_from_wire(digests_to_wire(digests)) == digests
     with pytest.raises(ProtocolError, match="digest hex"):
         digests_from_wire(["zz"])
+
+
+# ---------------------------------------------------------------------------
+# Socket hardening: dead-peer writes are typed; connect retry is bounded
+# ---------------------------------------------------------------------------
+
+
+def test_send_frame_to_dead_peer_is_protocol_error():
+    import socket
+
+    from distributeddeeplearning_tpu.serving.net import send_frame
+
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    b.close()
+    # One small frame may land in the kernel buffer before the EPIPE
+    # surfaces; a mid-write failure MUST come back as ProtocolError, not
+    # a raw OSError fished out of the middle of the send loop.
+    with pytest.raises(ProtocolError, match="peer gone"):
+        for _ in range(64):
+            send_frame(a, {"op": "submit", "pad": "x" * 4096})
+    a.close()
+
+
+def test_connect_with_retry_backoff_schedule_and_success():
+    import socket
+
+    from distributeddeeplearning_tpu.serving.net import connect_with_retry
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    host, port = srv.getsockname()
+    # NOT listening yet: the first dials get ECONNREFUSED, like a
+    # just-restarted worker that has printed ready but not accepted.
+    t = [0.0]
+    pauses = []
+
+    def sleep(s):
+        pauses.append(s)
+        t[0] += s
+        if len(pauses) == 3:
+            srv.listen(1)  # comes up mid-retry
+
+    sock = connect_with_retry(host, port, deadline_s=60.0,
+                              backoff_base_s=0.05, backoff_max_s=0.4,
+                              clock=lambda: t[0], sleep=sleep)
+    sock.close()
+    srv.close()
+    # Exponential doubling from the base, capped.
+    assert pauses == [pytest.approx(0.05), pytest.approx(0.1),
+                      pytest.approx(0.2)]
+
+
+def test_connect_with_retry_deadline_raises_last_oserror():
+    import socket
+
+    from distributeddeeplearning_tpu.serving.net import connect_with_retry
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    host, port = srv.getsockname()  # bound, never listening
+    t = [0.0]
+
+    def sleep(s):
+        t[0] += s
+
+    with pytest.raises(OSError):
+        connect_with_retry(host, port, deadline_s=1.0,
+                           backoff_base_s=0.3, backoff_max_s=5.0,
+                           clock=lambda: t[0], sleep=sleep)
+    assert t[0] < 1.0  # gave up before sleeping past the deadline
+    srv.close()

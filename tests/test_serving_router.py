@@ -261,10 +261,13 @@ def test_quarantine_reroutes_queued_requests_to_survivors():
 
 
 def test_quarantine_reports_inflight_as_failed():
+    # serving.request_retry=False pins the PRE-retry contract: an
+    # in-flight loss is a typed failure, never a silent re-run.
     model, params = _model_and_params()
     cfg = ServingConfig(
         slots=1, block_size=4, hbm_budget_mb=8, max_seq_len=48,
         prompt_buckets=(8, 16), replicas=2, router_policy="round_robin",
+        request_retry=False,
     )
     router = ReplicaRouter(model, params, cfg)
     for j in range(2):
@@ -283,8 +286,46 @@ def test_quarantine_reports_inflight_as_failed():
     assert [s.request.request_id for s in done] == [1]
     stats = router.stats()
     assert stats["failed"] == 1
+    assert stats["retried"] == 0
     assert any(e.get("event") == "request_failed" for e in router.events)
     del real_step
+
+
+def test_quarantine_retries_inflight_on_survivor_token_identically():
+    # serving.request_retry=True (the default): the dead replica's
+    # in-flight request is re-submitted from scratch on the survivor
+    # under a bumped attempt epoch — greedy decode is deterministic, so
+    # the retry's tokens match the undisturbed single-engine oracle.
+    model, params = _model_and_params()
+    cfg = ServingConfig(
+        slots=1, block_size=4, hbm_budget_mb=8, max_seq_len=48,
+        prompt_buckets=(8, 16), replicas=2, router_policy="round_robin",
+    )
+    assert cfg.request_retry  # retry is the fleet default
+    router = ReplicaRouter(model, params, cfg)
+    prompts = _prompts((5, 9))
+    ref = _reference(model, params, prompts)
+    for j, p in enumerate(prompts):
+        router.submit(Request(prompt=list(p), max_new_tokens=9,
+                              request_id=j))
+    router.step()  # both replicas admit their request (in flight now)
+
+    def boom():
+        raise RuntimeError("mid-flight fault")
+
+    router.replicas[0].engine.step = boom
+    done = router.run()
+    assert sorted(s.request.request_id for s in done) == [0, 1]
+    for s in done:
+        assert list(s.generated) == ref[s.request.request_id]
+    stats = router.stats()
+    assert stats["failed"] == 0
+    assert stats["retried"] == 1
+    assert stats["duplicate_deliveries"] == 0
+    assert router.epochs[0] == 1  # the lost attempt bumped the epoch
+    retried = [e for e in router.events
+               if e.get("event") == "request_retried"]
+    assert len(retried) == 1 and retried[0]["epoch"] == 1
 
 
 # ---------------------------------------------------------------------------

@@ -269,6 +269,37 @@ def test_heartbeat_timeout_zero_disables_staleness_sweep():
     assert not router.replicas[0].quarantined
 
 
+def test_staleness_sweep_is_pause_aware():
+    """A big gap BETWEEN sweeps is the router's own pause (blocked in a
+    supervisor respawn + dial, a host stall) — silence over a window
+    nobody listened through says nothing about the workers, and
+    charging them for it would quarantine healthy survivors right after
+    every restart. The sweep credits the gap back; a worker that stays
+    silent across normal-cadence sweeps afterwards is still caught."""
+    t, clock = _cell_clock()
+    workers, router = _fleet(2, _CFG, clock)
+    t[0] += 0.01
+    for w in workers:
+        w.pump()
+    router.step()  # establishes the sweep timebase
+    # Router blackout: 5x the heartbeat timeout with nobody sweeping.
+    # The workers sent nothing either — indistinguishable, so they get
+    # the benefit of the doubt.
+    t[0] += 5.0 * _CFG.heartbeat_timeout_s
+    router.step()
+    assert not any(r.quarantined for r in router.replicas)
+    # Genuine silence while the router IS listening still ages out:
+    # no worker pumps (no heartbeats), sweeps at normal sub-threshold
+    # cadence.
+    for _ in range(6):
+        t[0] += _CFG.heartbeat_timeout_s / 4.0
+        router.step()
+    assert all(r.quarantined for r in router.replicas)
+    assert all(
+        "StaleHeartbeat" in (r.error or "") for r in router.replicas
+    )
+
+
 # ---------------------------------------------------------------------------
 # Op surface: poll streaming, drain ack, shutdown, EOF-as-shutdown
 # ---------------------------------------------------------------------------

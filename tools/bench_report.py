@@ -153,6 +153,36 @@ def _headline(rec: dict) -> dict:
         probe = kvq["comparison"].get("logit_drift_probe")
         if isinstance(probe, dict):
             out["kvq_max_rel_drift"] = probe.get("max_rel_drift")
+    # SERVE_CHAOS_STATUS.json (tools/serve_chaos.py): the self-healing
+    # headline — every fault class healed with exactly-once serving and
+    # token parity, how fast the slowest restart recovered, and that the
+    # re-warm actually re-warmed (chains restored from the dead worker's
+    # spill checkpoint).
+    if rec.get("bench") == "serve_chaos" and isinstance(
+            rec.get("runs"), list):
+        runs = [r for r in rec["runs"] if isinstance(r, dict)]
+        out["chaos_all_green"] = bool(rec.get("ok"))
+        out["chaos_runs_green"] = sum(1 for r in runs if r.get("ok"))
+        out["chaos_fault_kinds"] = len(rec.get("kinds") or [])
+        out["chaos_duplicate_deliveries"] = sum(
+            int(r.get("duplicate_deliveries") or 0) for r in runs
+        )
+        out["chaos_token_parity"] = all(
+            bool(r.get("token_parity")) for r in runs
+        )
+        recoveries = [
+            rec_["recovery_s"]
+            for r in runs for rec_ in (r.get("restart_records") or [])
+            if isinstance(rec_.get("recovery_s"), (int, float))
+        ]
+        if recoveries:
+            out["chaos_max_recovery_s"] = round(max(recoveries), 3)
+        rewarm = [
+            int(rec_.get("spill_rewarm_chains") or 0)
+            for r in runs for rec_ in (r.get("restart_records") or [])
+        ]
+        if rewarm:
+            out["chaos_max_rewarm_chains"] = max(rewarm)
     # FLEET.json (tools/telemetry_report.py fleet rehearsal): the pod-level
     # headline the aggregator exists for.
     fh = rec.get("headline")
@@ -182,9 +212,12 @@ def main() -> int:
     # aggregator's committed artifact and carries the pod-level headline
     # (goodput fraction, max step skew) this index exists to surface.
     paths = sorted(glob.glob(os.path.join(_DIR, "BENCH_*.json")))
-    fleet_path = os.path.join(_DIR, "FLEET.json")
-    if os.path.exists(fleet_path):
-        paths.append(fleet_path)
+    # SERVE_CHAOS_STATUS.json rides along too: the serving chaos
+    # harness's committed artifact (self-healing fleet headline).
+    for extra in ("FLEET.json", "SERVE_CHAOS_STATUS.json"):
+        extra_path = os.path.join(_DIR, extra)
+        if os.path.exists(extra_path):
+            paths.append(extra_path)
     for path in paths:
         name = os.path.basename(path)
         if name == os.path.basename(_OUT):
@@ -206,7 +239,7 @@ def main() -> int:
     report = {
         "schema_version": 1,
         "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "source_glob": "BENCH_*.json + FLEET.json",
+        "source_glob": "BENCH_*.json + FLEET.json + SERVE_CHAOS_STATUS.json",
         "artifacts": artifacts,
         "unreadable": unreadable,
     }
