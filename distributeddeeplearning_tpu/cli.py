@@ -686,7 +686,8 @@ def _fleet_plan(config: str, overrides: list[str], fleet: int, *,
                 telemetry_dir: str | None = None,
                 spill_dir: str | None = None,
                 worker_args: list[str] | None = None,
-                base_env: dict | None = None):
+                base_env: dict | None = None,
+                roles: list[str] | None = None):
     """``[(cmd, env), ...]`` for every worker of ``cli serve --fleet N``
     — pure (no processes spawned), so tests can pin the plan.
 
@@ -696,7 +697,15 @@ def _fleet_plan(config: str, overrides: list[str], fleet: int, *,
     workers sharing one telemetry dir write non-clobbering artifacts
     that ``telemetry_aggregate.build_fleet`` merges) and the coordinated
     -launch env vars are scrubbed — a fleet worker is single-process by
-    construction."""
+    construction.
+
+    ``roles`` (from ``serving.prefill_replicas``) pins worker ``i`` to
+    ``serving.role=roles[i]`` via a trailing override — trailing so it
+    wins over any user-supplied role — and scrubs the fleet-level
+    ``prefill_replicas`` knob (a child validates with ``fleet=1``, and
+    the split topology is the PARENT'S concern; the child only needs
+    its own phase). Because the plan is per-index, a supervisor respawn
+    re-runs plan[i] and the worker rejoins with its original role."""
     import os
 
     plan = []
@@ -711,6 +720,9 @@ def _fleet_plan(config: str, overrides: list[str], fleet: int, *,
         ]
         for o in overrides:
             cmd += ["--override", o]
+        if roles is not None:
+            cmd += ["--override", f"serving.role={roles[i]}",
+                    "--override", "serving.prefill_replicas=0"]
         if telemetry_dir:
             cmd += ["--telemetry-dir", telemetry_dir]
         if spill_dir:
@@ -801,12 +813,22 @@ def cmd_serve_fleet(args) -> int:
     spill_dir = None
     if getattr(cfg.serving, "spill_blocks", 0) > 0:
         spill_dir = tdir or tempfile.mkdtemp(prefix="ddl_fleet_spill_")
+    # Disaggregated topology: serving.prefill_replicas=K splits the
+    # fleet into K prefill + (N-K) decode workers (fenced above: 0 < K
+    # < fleet, prefix_cache on). Roles are pinned per plan index, so
+    # supervisor respawns preserve the topology.
+    pr = int(getattr(cfg.serving, "prefill_replicas", 0))
+    roles = (
+        ["prefill"] * pr + ["decode"] * (args.fleet - pr)
+        if pr > 0 else None
+    )
     plan = _fleet_plan(
         args.config, args.override, args.fleet,
         host=cfg.serving.worker_host,
         port_base=cfg.serving.worker_port,
         telemetry_dir=tdir,
         spill_dir=spill_dir,
+        roles=roles,
     )
     procs = [None] * args.fleet
     threads, endpoints = [], []

@@ -503,6 +503,32 @@ class ServingConfig:
     # the attempt env. Fleet-only — fenced by name under in-process
     # `serve` (check_serving_composition).
     fault_injection: str = ""
+    # Disaggregated prefill/decode serving (docs/SERVING.md
+    # disaggregation section). Per-engine phase role:
+    #   'unified' — the PR 18 behavior: every replica prefills AND
+    #     decodes (default, fully back-compatible);
+    #   'prefill' — the engine runs bulk/suffix prefill only, publishes
+    #     the prompt's KV blocks into its prefix trie, and queues a
+    #     handoff (chain digests + raw block bytes) instead of decoding;
+    #   'decode'  — the engine adopts handed-off chains into its own
+    #     trie/pool and serves the decode phase.
+    # role != 'unified' requires prefix_cache=true (the trie IS the
+    # handoff ledger); 'prefill' is incompatible with speculation
+    # (drafting is decode-side work); any split role under
+    # static batching is NotImplementedError. All fenced by name.
+    role: str = "unified"
+    # Fleet topology split for `cli serve --fleet N`: the first
+    # prefill_replicas workers boot with role='prefill', the rest with
+    # role='decode'. 0 = no split (every worker keeps serving.role,
+    # normally 'unified'). Must satisfy 0 < prefill_replicas < fleet
+    # when set — a fleet needs at least one of each phase — and
+    # requires prefix_cache=true. Fenced in check_fleet_composition.
+    prefill_replicas: int = 0
+    # Upper bound, in WHOLE BLOCKS, on one binary KV handoff frame's
+    # body; a longer chain is shipped as several frames (same request,
+    # ascending `part` index) so no frame outgrows the wire cap. Must
+    # be >= 1 — fenced by name.
+    handoff_blocks_per_frame: int = 64
 
 
 @dataclasses.dataclass(frozen=True)

@@ -41,7 +41,7 @@ def event_record(name: str, step: int, **fields) -> dict:
 SERVING_EVENTS = (
     "request_admitted", "first_token", "request_completed",
     "request_shed", "request_rerouted", "request_failed",
-    "request_retried",
+    "request_retried", "request_handoff",
 )
 
 

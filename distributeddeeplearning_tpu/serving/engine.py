@@ -106,7 +106,8 @@ from ..metrics import serving_event, serving_gauges
 from ..telemetry import NULL_TELEMETRY, SPEC_ACCEPT_HIST
 from .quant import dequantize_params, quantization_error, quantize_params
 from .scheduler import (
-    KVBlockPool, Request, RequestState, Scheduler, blocks_for, ngram_draft,
+    KVBlockPool, Request, RequestState, Scheduler, blocks_for,
+    chain_digests, ngram_draft,
 )
 
 # Pool leaves shared between the B=1 prefill and B=slots decode programs
@@ -286,6 +287,38 @@ def _check_kv_quant(kv_quant, spill_codec) -> str:
             "pass-through of the int8 payload) or kv_quant='off'."
         )
     return mode
+
+
+# serving.role domain: disaggregated prefill/decode phase roles
+# (docs/SERVING.md disaggregation section).
+SERVING_ROLES = ("unified", "prefill", "decode")
+
+
+def _check_role(role, prefix_cache, speculation) -> str:
+    """The disaggregation-role composition fences (by name, config time),
+    shared by ``check_serving_composition`` and ``ServingEngine``.
+    Returns the validated role."""
+    r = str(role or "unified")
+    if r not in SERVING_ROLES:
+        raise ValueError(
+            f"serving.role must be one of {SERVING_ROLES}, got {role!r}"
+        )
+    if r != "unified" and not prefix_cache:
+        raise ValueError(
+            f"serving.role={r!r} x prefix_cache=False: the prefix trie IS "
+            "the handoff ledger — a prefill replica publishes the prompt's "
+            "blocks into its trie and a decode replica adopts them into "
+            "its own, so role-split serving requires "
+            "serving.prefix_cache=true"
+        )
+    if r == "prefill" and str(speculation or "off") != "off":
+        raise ValueError(
+            f"serving.role='prefill' x speculation={speculation!r}: "
+            "drafting and verify are DECODE-side work and a prefill "
+            "replica never decodes — set speculation='off' on prefill "
+            "replicas (decode replicas may keep it)"
+        )
+    return r
 
 
 # Fault classes the serving chaos DSL understands (config.py
@@ -484,6 +517,44 @@ def check_serving_composition(cfg, *, fleet: int = 0) -> None:
     _check_speculation(
         getattr(s, "speculation", "off"), s.block_size, kernel
     )
+    # Disaggregation fences: role domain, trie dependency, the
+    # prefill x speculation conflict, and the fleet topology knobs.
+    _check_role(
+        getattr(s, "role", "unified"), prefix_on,
+        getattr(s, "speculation", "off"),
+    )
+    pr = int(getattr(s, "prefill_replicas", 0))
+    if pr < 0:
+        raise ValueError(
+            f"serving.prefill_replicas must be >= 0 (0 = no role split), "
+            f"got {pr}"
+        )
+    if pr > 0:
+        if fleet < 1:
+            raise ValueError(
+                f"serving.prefill_replicas={pr} x in-process serve: the "
+                "role split pins WORKER PROCESSES to phases, which only "
+                "exist under `serve --fleet N` — run a fleet or drop the "
+                "split"
+            )
+        if pr >= fleet:
+            raise ValueError(
+                f"serving.prefill_replicas={pr} x fleet={fleet}: a split "
+                "fleet needs at least one decode replica "
+                "(prefill_replicas < fleet) — no one would ever emit a "
+                "token"
+            )
+        if not prefix_on:
+            raise ValueError(
+                f"serving.prefill_replicas={pr} x prefix_cache=False: "
+                "the prefix trie is the handoff ledger on BOTH sides of "
+                "the split — set serving.prefix_cache=true"
+            )
+    if int(getattr(s, "handoff_blocks_per_frame", 64)) < 1:
+        raise ValueError(
+            "serving.handoff_blocks_per_frame must be >= 1, got "
+            f"{s.handoff_blocks_per_frame}"
+        )
     # Fleet self-healing fences (restart budget / backoff / checkpoint
     # cadence / fault-injection DSL).
     _check_fleet_healing(s, fleet)
@@ -565,6 +636,24 @@ class ServingEngine:
         self.kv_quant = _check_kv_quant(
             getattr(cfg, "kv_quant", "off"), self.spill_codec
         )
+        # Disaggregation phase role (module docstring / docs/SERVING.md):
+        # 'prefill' runs bulk/suffix prefill then queues a KV-chain
+        # handoff instead of decoding; 'decode' adopts handed-off chains.
+        # Fenced here as well as at config time — tests build engines
+        # directly from a ServingConfig.
+        self.role = _check_role(
+            getattr(cfg, "role", "unified"), self.prefix_cache,
+            getattr(cfg, "speculation", "off"),
+        )
+        if static_batching and self.role != "unified":
+            raise NotImplementedError(
+                f"serving.role={self.role!r} x static_batching: the "
+                "static baseline forms whole batches and runs them to "
+                "completion in one engine — there is no phase boundary "
+                "to split across replicas; benchmark role-split fleets "
+                "against the unified CONTINUOUS fleet instead "
+                "(tools/serve_bench.py disagg block does)"
+            )
         if static_batching and self.kv_quant != "off":
             raise NotImplementedError(
                 f"serving.kv_quant={self.kv_quant!r} x static_batching: "
@@ -708,7 +797,26 @@ class ServingEngine:
             self.max_seq_len,
             kv_bytes_per_token=self.block_bytes // bs,
             kv_quant=self.kv_quant,
+            role=self.role,
         )
+        # Handoff queue (role='prefill'): export records awaiting pickup
+        # by the worker/router — each is the request plus its chain
+        # digests and captured raw block bytes. Adoption/export stats
+        # feed stats() and the disagg bench block.
+        self._handoffs: list[dict] = []
+        self.handoff_stats = {
+            "exported": 0, "export_blocks": 0, "export_bytes": 0,
+            "adopted": 0, "adopt_blocks": 0, "adopt_bytes": 0,
+            "adopt_skipped_blocks": 0, "adopt_fallbacks": 0,
+        }
+        # True async spill promote (ROADMAP 2b): device_put uploads for
+        # promoted chains are kicked for EVERY state admitted this step
+        # before the first suffix prefill dispatches, so the H2D copies
+        # hide under earlier admissions' prefill compute (and the
+        # preceding decode). False restores the upload-at-prefill-
+        # dispatch behavior — the bench's sync baseline.
+        self.promote_async = True
+        self._staged_promotes: dict[int, tuple] = {}
         self._table = np.zeros((S, self.pages), np.int32)
         self._lens = np.zeros((S,), np.int32)
         self._tok = np.zeros((S,), np.int32)
@@ -837,23 +945,26 @@ class ServingEngine:
         adoption, flush) — release its payload."""
         self._spill_store.pop(chain_hash, None)
 
-    def _apply_promotions(self, state: RequestState) -> None:
-        """Upload the spill-store payloads for ``state``'s promoted
-        blocks. The ``device_put`` dispatches FIRST — it is async, so the
-        host->device copies overlap the operand prep and suffix-prefill
-        dispatch that follow; the eager scatter is ordered behind the
-        copy by data dependency alone. Scattered rows land in blocks the
-        page table maps BELOW the row's ``seq_lens`` cursor with exactly
-        the bytes the trie published there (bitwise for fp), so
-        published-block immutability holds. Promoted nodes carry
-        refcount >= 1 (the admission acquired the chain), so they cannot
-        be re-spilled before this upload lands."""
+    def _start_promotions(self, state: RequestState) -> None:
+        """Stage ``state``'s promoted-chain uploads: pop the spill-store
+        payloads and DISPATCH the ``jax.device_put`` copies now, parking
+        the in-flight device buffers in ``_staged_promotes`` for the
+        scatter in :meth:`_apply_promotions`. ``device_put`` is async, so
+        everything the engine does between here and the scatter — other
+        admissions' prefills, the preceding decode's tail — overlaps the
+        H2D copy. ``step()`` calls this for every admitted state at
+        admission/match time (``promote_async``, ROADMAP 2b); with the
+        flag off, :meth:`_apply_promotions` stages inline (the upload
+        waits until suffix-prefill dispatch — the old behavior). Staged
+        nodes carry refcount >= 1 (the admission acquired the chain), so
+        they cannot be re-spilled before the scatter lands."""
         pairs = state.promoted
         if not pairs:
             return
         state.promoted = []
         t0 = time.perf_counter()
         payloads = []
+        codec = "fp"
         for _, h in pairs:
             codec, payload = self._spill_store.pop(h)
             payloads.append(payload)
@@ -875,6 +986,28 @@ class ServingEngine:
             uploads.append(up)
         self.spill_stats["promote_bytes"] += nbytes
         self.spill_stats["promote_transfers"] += 1
+        self._staged_promotes[state.request.request_id] = (codec, ids, n,
+                                                           uploads)
+        self._tel.hist("promote_stage").record(time.perf_counter() - t0)
+
+    def _apply_promotions(self, state: RequestState) -> None:
+        """Scatter ``state``'s staged promoted-chain uploads into the
+        pool. Scattered rows land in blocks the page table maps BELOW the
+        row's ``seq_lens`` cursor with exactly the bytes the trie
+        published there (bitwise for fp), so published-block immutability
+        holds. ``promote_wait`` measures the host time this request's
+        prefill dispatch spends on promotion — with ``promote_async`` the
+        upload was already in flight (scatter dispatch only); without it,
+        the pop + ``device_put`` dispatch are paid here, which is exactly
+        the delta the kv_hierarchy bench pins."""
+        t0 = time.perf_counter()
+        staged = self._staged_promotes.pop(state.request.request_id, None)
+        if staged is None:
+            if not state.promoted:
+                return
+            self._start_promotions(state)
+            staged = self._staged_promotes.pop(state.request.request_id)
+        codec, ids, n, uploads = staged
         it = iter(uploads)
 
         def scatter(path, leaf):
@@ -901,6 +1034,183 @@ class ServingEngine:
         # the suffix prefill; PR 12's fleet merge aggregates this per
         # replica.
         self._tel.hist("promote_wait").record(time.perf_counter() - t0)
+
+    # ------------------------------------------------------------------
+    # prefill/decode disaggregation (docs/SERVING.md): block export on
+    # the prefill side, chain adoption on the decode side. The block is
+    # the transfer unit, the trie is the handoff ledger.
+    # ------------------------------------------------------------------
+
+    def _capture_blocks(self, ids: list[int]) -> tuple[list[bytes], int]:
+        """Copy pool rows ``ids`` to host as raw per-block byte strings:
+        one coalesced ``device_get`` for the whole chain, then each
+        block's payload is its pool-leaf rows concatenated in
+        ``_pool_leaves`` order — bitwise, whatever ``kv_quant`` is, so an
+        int8 pool ships ~3.2x fewer bytes with NO re-quantization on the
+        wire (the scale rows ride along as two of the four leaves).
+        Returns ``(payloads, total_bytes)``; each payload is exactly
+        ``self.block_bytes`` long, which the receiver verifies."""
+        if not ids:
+            return [], 0
+        leaves = self._pool_leaves()
+        idx = np.asarray(ids, np.int32)
+        host = jax.device_get(tuple(leaf[idx] for leaf in leaves))
+        payloads = [
+            b"".join(
+                np.ascontiguousarray(arr[i]).tobytes() for arr in host
+            )
+            for i in range(len(ids))
+        ]
+        return payloads, sum(len(p) for p in payloads)
+
+    def _queue_handoff(self, state: RequestState, *, written: int) -> None:
+        """Prefill-side half of a handoff: export the prompt's cached
+        chain (digests + pool rows), retire the lane WITHOUT finishing
+        the request, and park the capture on ``_handoffs`` for the
+        worker pump to frame out. ``written`` is the count of prompt
+        positions whose KV this replica actually wrote — ``len(prompt)``
+        after a prefill, ``len(prompt) - 1`` on the decode route (a
+        full-prefix hit never ran a forward, so the LAST prompt token's
+        KV does not exist yet; publishing through it would hand off a
+        block with one garbage position when the prompt length lands on
+        a block boundary). The export itself needs no ``written`` cap:
+        ``chain_digests`` stops at ``(len(prompt) - 1) // block_size``
+        full blocks, which never reaches the last prompt position on
+        either path. The capture happens before ``complete_handoff``
+        releases the chain refs, so no eviction can recycle the rows
+        under the ``device_get``."""
+        req, slot = state.request, state.slot
+        digests, ids = self.scheduler.pool.export_chain(req.prompt)
+        payloads, nbytes = self._capture_blocks(ids)
+        self.scheduler.complete_handoff(slot, self.clock(), written=written)
+        self._temp[slot] = 0.0
+        self._lens[slot] = 0
+        self._table[slot] = 0  # park the lane on the null block
+        self._handoffs.append({
+            "state": state,
+            "request": req,
+            "digests": digests,
+            "payloads": payloads,
+        })
+        self.scheduler.handoff_queue_depth = len(self._handoffs)
+        self.scheduler.handoff_bytes_total += nbytes
+        st = self.handoff_stats
+        st["exported"] += 1
+        st["export_blocks"] += len(ids)
+        st["export_bytes"] += nbytes
+        self._event(
+            "request_handoff", state, slot=slot,
+            blocks=len(ids), kv_bytes=nbytes,
+        )
+
+    def take_handoffs(self) -> list[dict]:
+        """Drain the pending handoff queue (worker pump / in-process
+        router hook). Each record carries the retired ``state``, its
+        ``request``, the chain ``digests``, and the raw block
+        ``payloads`` — everything a transport needs to build KV frames."""
+        out, self._handoffs = self._handoffs, []
+        self.scheduler.handoff_queue_depth = 0
+        return out
+
+    def _scatter_raw_blocks(self, blocks: list[int],
+                            raws: list[bytes]) -> int:
+        """Write wire block payloads into freshly-alloc'd pool rows:
+        the exact inverse of :meth:`_capture_blocks` — re-slice each
+        payload by the pool leaves' row dtype/shape (bfloat16 rows
+        reconstruct via ``ml_dtypes`` through ``np.frombuffer``), ONE
+        ``device_put`` per leaf for the whole batch, one fused scatter
+        over the cache. Raises ``ValueError`` on a size mismatch (sender
+        pool layout differs) BEFORE any device write."""
+        leaves = self._pool_leaves()
+        per_leaf: list[list[np.ndarray]] = [[] for _ in leaves]
+        nbytes = 0
+        for raw in raws:
+            off = 0
+            for j, leaf in enumerate(leaves):
+                shape = leaf.shape[1:]
+                dt = np.dtype(leaf.dtype)
+                count = int(np.prod(shape))
+                nb = count * dt.itemsize
+                if off + nb > len(raw):
+                    raise ValueError(
+                        f"handoff block payload is {len(raw)} bytes; "
+                        f"this pool's blocks are {self.block_bytes} — "
+                        "sender kv_quant/model layout differs"
+                    )
+                per_leaf[j].append(
+                    np.frombuffer(
+                        raw, dtype=dt, count=count, offset=off
+                    ).reshape(shape)
+                )
+                off += nb
+            if off != len(raw):
+                raise ValueError(
+                    f"handoff block payload is {len(raw)} bytes; "
+                    f"this pool's blocks are {off} — "
+                    "sender kv_quant/model layout differs"
+                )
+            nbytes += off
+        ids = jnp.asarray(np.asarray(blocks, np.int32))
+        uploads = [jax.device_put(np.stack(rows)) for rows in per_leaf]
+        it = iter(uploads)
+
+        def scatter(path, leaf):
+            if getattr(path[-1], "key", None) not in _POOL_LEAVES:
+                return leaf
+            return leaf.at[ids].set(next(it))
+
+        self._cache = jax.tree_util.tree_map_with_path(
+            scatter, self._cache
+        )
+        return nbytes
+
+    def adopt_chain(self, prompt: list[int], payloads: list[bytes], *,
+                    offset: int = 0) -> int:
+        """Decode-side half of a handoff: graft ``payloads`` — raw block
+        bytes for chain positions ``offset .. offset+len(payloads)`` of
+        ``prompt`` — into this replica's pool/trie, so the request's
+        subsequent :meth:`submit` admits as a (near-)full prefix hit.
+        Dedupes against local state first (``match_digests``): positions
+        the trie already holds are skipped, so a shared prefix transfers
+        once however many requests ride it. Degrades, never breaks:
+        a stale slice (the sender skipped blocks this pool no longer
+        holds) or an unallocatable pool adopts NOTHING and returns 0 —
+        the request simply cold-prefills. Returns blocks adopted."""
+        digests = chain_digests(prompt, self.block_size)
+        k_end = offset + len(payloads)
+        if k_end > len(digests):
+            raise ValueError(
+                f"adopt_chain: {len(payloads)} payload blocks at offset "
+                f"{offset} overrun the prompt's {len(digests)}-block chain"
+            )
+        pool = self.scheduler.pool
+        st = self.handoff_stats
+        run = pool.match_digests(digests[:k_end])
+        if run < offset:
+            # The sender sliced against a digest summary that has since
+            # been evicted here — the graft would have no parent.
+            st["adopt_fallbacks"] += 1
+            return 0
+        m = run  # first position we actually need from the wire
+        if m >= k_end:
+            st["adopt_skipped_blocks"] += len(payloads)
+            return 0
+        blocks = pool.alloc(k_end - m)
+        if blocks is None:
+            st["adopt_fallbacks"] += 1
+            return 0
+        try:
+            nbytes = self._scatter_raw_blocks(blocks, payloads[m - offset:])
+            pool.adopt_chain(prompt, blocks, start=m)
+        except ValueError:
+            pool.free([b for b in blocks if b in pool._allocated])
+            raise
+        st["adopted"] += 1
+        st["adopt_blocks"] += len(blocks)
+        st["adopt_bytes"] += nbytes
+        st["adopt_skipped_blocks"] += m - offset
+        self.scheduler.handoff_bytes_total += nbytes
+        return len(blocks)
 
     def constrain_pool(self, num_blocks: int) -> None:
         """Rebuild the pool with ``num_blocks <= self.num_blocks`` usable
@@ -1242,6 +1552,15 @@ class ServingEngine:
         self._top_k[slot] = req.top_k
         self._top_p[slot] = req.top_p
         if state.decode_route:
+            if self.role == "prefill":
+                # Full-prefix hit on a PREFILL replica: the entire
+                # exportable chain is already resident, so hand off
+                # without running a forward at all. written=len-1: the
+                # last prompt token's KV was never computed here (no
+                # decode step runs on this role) — the retirement
+                # publish must not cover it.
+                self._queue_handoff(state, written=len(req.prompt) - 1)
+                return
             # Full-prefix hit: every position but the last prompt token is
             # cached, and matching is capped there — so there is nothing
             # to prefill. Arm the lane with the last prompt token as the
@@ -1273,6 +1592,19 @@ class ServingEngine:
         )
         self.calls["prefill"] += 1
         self._fold_pools(cache1)
+        if self.role == "prefill":
+            # Prefill-only completion: publish the prompt's blocks (KV
+            # written and final) so export_chain sees the whole chain,
+            # then queue the handoff instead of arming a decode lane.
+            # The token the prefill sampled is DISCARDED, not shipped:
+            # the decode replica re-samples it from the same
+            # fold_in(seed, request_id) rng chain over the same
+            # logits, so greedy (and seeded sampled) output is
+            # token-identical to a unified replica — parity by
+            # construction, not by trusting the wire.
+            self.scheduler.publish_prefix(state, len(req.prompt))
+            self._queue_handoff(state, written=len(req.prompt))
+            return
         tok = int(tok[0])
         now = self.clock()
         state.generated.append(tok)
@@ -1311,6 +1643,13 @@ class ServingEngine:
                 # decode lifecycle is traceable end-to-end in the merged
                 # Perfetto view.
                 sp.set(request_ids=[s.request.request_id for s in admitted])
+        if self.promote_async:
+            # Kick EVERY admitted state's promote uploads before the
+            # first prefill dispatches: the H2D copies run while earlier
+            # admissions prefill, instead of each waiting for its own
+            # prefill's operand prep (ROADMAP 2b, true async promote).
+            for state in admitted:
+                self._start_promotions(state)
         for state in admitted:
             extra = {}
             if self.prefix_cache:
@@ -1542,4 +1881,6 @@ class ServingEngine:
                 "spill_store_blocks": len(self._spill_store),
                 **self.spill_stats,
             })
+        if self.role != "unified":
+            out["handoff"] = dict(self.handoff_stats)
         return out

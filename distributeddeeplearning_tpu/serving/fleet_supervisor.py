@@ -236,6 +236,9 @@ class FleetSupervisor:
         self._emit(event_record(
             "worker_exit", self.router.tick_count,
             replica=h.index, kind=kind, rc=rc, attempt=h.attempt,
+            role=getattr(
+                self.router.replicas[h.index], "role", "unified"
+            ),
         ))
         expected = (
             self.shutting_down
@@ -304,6 +307,10 @@ class FleetSupervisor:
             "replica": h.index,
             "attempt": h.attempt,
             "kind": h.last_kind,
+            # The respawn plan is per-index (cli._fleet_plan), so a
+            # restarted worker rejoins with its predecessor's ROLE — a
+            # dead prefill replica comes back prefill.
+            "role": str(ready.get("role", "unified")),
             "recovery_s": round(recovery_s, 6),
             "spill_rewarm_chains": int(
                 ready.get("spill_rewarm_chains", 0)
@@ -366,6 +373,9 @@ class FleetSupervisor:
             "gave_up": [h.index for h in self.handles if h.gave_up],
             "per_worker": [
                 {"replica": h.index, "attempt": h.attempt,
+                 "role": getattr(
+                     self.router.replicas[h.index], "role", "unified"
+                 ),
                  "restarts": h.restarts_done,
                  "last_kind": h.last_kind,
                  "gave_up": h.gave_up, "stopped": h.stopped}

@@ -1,11 +1,24 @@
 """Wire framing for the cross-process serving fleet.
 
 One frame = a 4-byte big-endian unsigned length followed by that many
-bytes of UTF-8 JSON:
+bytes of payload. Two payload kinds share the stream:
 
     +----------------+---------------------------+
     | len (u32, BE)  |  payload: UTF-8 JSON body |
     +----------------+---------------------------+
+
+    +----------------+-------+--------------+-----------+------------+
+    | len (u32, BE)  | magic | meta_len u32 | meta JSON | raw body   |
+    +----------------+-------+--------------+-----------+------------+
+
+The second is the BINARY KV frame (:class:`KVFrame`) that carries paged
+KV-block bytes for a prefill→decode handoff: ``magic`` is
+:data:`KV_MAGIC` (its first byte, 0x00, can never begin JSON text, so
+the two kinds are discriminated from the payload's first bytes alone),
+``meta`` is a small JSON header (request wire form, chain digests,
+per-block byte ``sizes``), and ``body`` is the concatenated raw block
+bytes — pool rows shipped bitwise, so an int8-quantized pool's ~3.2x
+size win carries straight onto the wire.
 
 The codec is deliberately boring — stdlib sockets, stdlib json — and it
 lives apart from any socket so the framing itself is unit-testable on
@@ -15,15 +28,17 @@ close, which is exactly the shape a nonblocking ``recv`` loop produces.
 
 Every malformed input path raises :class:`ProtocolError` BY NAME —
 oversized declared length (before buffering a byte of the payload),
-payload that is not valid JSON, a frame that closes mid-payload. A
+payload that is not valid JSON, a frame that closes mid-payload, a KV
+frame whose declared block sizes overrun or underrun its actual body. A
 router or worker treats any ``ProtocolError`` on a connection as that
 peer being gone: there is no resync point inside a corrupted
 length-prefixed stream.
 
 ``MAX_FRAME_BYTES`` bounds a single frame (default 16 MiB): the largest
-legitimate frame is a heartbeat digest summary or a batch of result
-token lists, both tiny. The bound is what turns a corrupt or hostile
-length word into a typed error instead of an OOM.
+legitimate frames are a heartbeat digest summary and one KV handoff
+part (the sender chunks long chains across parts —
+``serving.handoff_blocks_per_frame``). The bound is what turns a
+corrupt or hostile length word into a typed error instead of an OOM.
 """
 
 from __future__ import annotations
@@ -36,6 +51,11 @@ import struct
 MAX_FRAME_BYTES = 16 << 20
 
 _LEN = struct.Struct(">I")
+
+# First payload bytes of a binary KV frame. JSON payloads always start
+# with a printable character, never 0x00, so four bytes of payload decide
+# the kind with zero ambiguity (and version the binary layout: "KV1").
+KV_MAGIC = b"\x00KV1"
 
 
 class ProtocolError(RuntimeError):
@@ -53,6 +73,86 @@ def encode_frame(obj, *, max_bytes: int = MAX_FRAME_BYTES) -> bytes:
             f"{max_bytes} — refusing to send an unreceivable frame"
         )
     return _LEN.pack(len(payload)) + payload
+
+
+class KVFrame:
+    """One decoded binary KV frame: a JSON ``meta`` header plus the raw
+    concatenated block bytes in ``body``. ``meta['sizes']`` gives each
+    block's byte length in order, so :meth:`blocks` re-slices the body
+    without copying the stream twice. The decoder has already verified
+    that the sizes sum exactly to ``len(body)``."""
+
+    __slots__ = ("meta", "body")
+
+    def __init__(self, meta: dict, body: bytes):
+        self.meta = meta
+        self.body = body
+
+    def blocks(self) -> list[bytes]:
+        out, off = [], 0
+        for size in self.meta["sizes"]:
+            out.append(self.body[off:off + size])
+            off += size
+        return out
+
+    def __repr__(self) -> str:  # keep test failures readable
+        return (f"KVFrame(op={self.meta.get('op')!r}, "
+                f"blocks={len(self.meta.get('sizes', []))}, "
+                f"body={len(self.body)}B)")
+
+
+def encode_kv_frame(meta: dict, body: bytes, *,
+                    max_bytes: int = MAX_FRAME_BYTES) -> bytes:
+    """One length-prefixed BINARY frame: ``KV_MAGIC | meta_len(u32,BE) |
+    meta JSON | body``. ``meta['sizes']`` is required and must sum to
+    ``len(body)`` — encode enforces the same invariant decode checks, so
+    a torn handoff can never be framed as valid."""
+    sizes = meta.get("sizes")
+    if not isinstance(sizes, list) or sum(sizes) != len(body):
+        raise ProtocolError(
+            f"kv frame meta sizes {sizes!r} do not cover body "
+            f"({len(body)} bytes)"
+        )
+    meta_json = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    payload_len = len(KV_MAGIC) + 4 + len(meta_json) + len(body)
+    if payload_len > max_bytes:
+        raise ProtocolError(
+            f"kv frame payload {payload_len} bytes exceeds max_bytes "
+            f"{max_bytes} — chunk the chain across more parts "
+            "(serving.handoff_blocks_per_frame)"
+        )
+    return b"".join((_LEN.pack(payload_len), KV_MAGIC,
+                     _LEN.pack(len(meta_json)), meta_json, body))
+
+
+def _parse_kv_payload(payload: bytes) -> KVFrame:
+    head = len(KV_MAGIC) + _LEN.size
+    if len(payload) < head:
+        raise ProtocolError(
+            f"kv frame payload {len(payload)} bytes is shorter than its "
+            f"{head}-byte header"
+        )
+    (meta_len,) = _LEN.unpack_from(payload, len(KV_MAGIC))
+    if head + meta_len > len(payload):
+        raise ProtocolError(
+            f"kv frame meta length {meta_len} overruns the "
+            f"{len(payload)}-byte payload"
+        )
+    try:
+        meta = json.loads(payload[head:head + meta_len].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ProtocolError(f"malformed kv frame meta: {exc}") from exc
+    sizes = meta.get("sizes") if isinstance(meta, dict) else None
+    if (not isinstance(meta, dict) or not isinstance(sizes, list)
+            or not all(isinstance(s, int) and s >= 0 for s in sizes)):
+        raise ProtocolError(f"kv frame meta missing block sizes: {meta!r}")
+    body = payload[head + meta_len:]
+    if sum(sizes) != len(body):
+        raise ProtocolError(
+            f"kv frame truncated mid-block: declared sizes sum to "
+            f"{sum(sizes)} bytes but body holds {len(body)}"
+        )
+    return KVFrame(meta, body)
 
 
 class FrameDecoder:
@@ -90,6 +190,9 @@ class FrameDecoder:
                 break
             payload = bytes(self._buf[_LEN.size:_LEN.size + n])
             del self._buf[:_LEN.size + n]
+            if payload[:len(KV_MAGIC)] == KV_MAGIC:
+                out.append(_parse_kv_payload(payload))
+                continue
             try:
                 out.append(json.loads(payload.decode("utf-8")))
             except (ValueError, UnicodeDecodeError) as exc:
@@ -109,7 +212,22 @@ def send_frame(sock: socket.socket, obj, *,
     resync point, so the connection is dead either way, and callers get
     ONE exception type for 'this peer is gone' instead of fishing raw
     ``OSError`` out of the middle of a write."""
-    data = memoryview(encode_frame(obj, max_bytes=max_bytes))
+    _send_bytes(sock, encode_frame(obj, max_bytes=max_bytes), timeout_s)
+
+
+def send_kv_frame(sock: socket.socket, meta: dict, body: bytes, *,
+                  max_bytes: int = MAX_FRAME_BYTES,
+                  timeout_s: float = 30.0) -> None:
+    """Write one binary KV frame (:func:`encode_kv_frame`) with the same
+    nonblocking-socket discipline as :func:`send_frame`."""
+    _send_bytes(
+        sock, encode_kv_frame(meta, body, max_bytes=max_bytes), timeout_s
+    )
+
+
+def _send_bytes(sock: socket.socket, payload: bytes,
+                timeout_s: float) -> None:
+    data = memoryview(payload)
     while data:
         try:
             sent = sock.send(data)

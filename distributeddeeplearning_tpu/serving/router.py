@@ -118,6 +118,12 @@ class Replica:
     last_heartbeat_s = 0.0
 
     @property
+    def role(self) -> str:
+        """Phase pin (serving.role): 'unified' serves end-to-end,
+        'prefill' hands finished chains off, 'decode' adopts them."""
+        return getattr(self.engine, "role", "unified")
+
+    @property
     def block_size(self) -> int:
         return self.engine.block_size
 
@@ -131,7 +137,10 @@ class Replica:
 
     @property
     def engine_idle(self) -> bool:
-        return self.engine.scheduler.idle
+        # A queued-but-untaken handoff is in-flight fleet work: the
+        # router must not read idle before dispatching it.
+        return (self.engine.scheduler.idle
+                and not self.engine.scheduler.handoff_queue_depth)
 
     def load_gauges(self, now: float) -> dict:
         """Dispatch-time load signals — pulled FRESH from the scheduler
@@ -176,6 +185,39 @@ class Replica:
 
     def step(self) -> bool:
         return self.engine.step()
+
+    def take_handoffs(self) -> list[dict]:
+        """Drain the engine's pending prefill→decode handoffs into the
+        router's normalized record shape (the socket transport produces
+        the same shape from KV frames, so routing never forks)."""
+        out = []
+        for h in self.engine.take_handoffs():
+            out.append({
+                "request": h["request"],
+                "arrival_s": h["state"].arrival_s,
+                "epoch": None,  # router fills from its own ledger
+                "digests": list(h["digests"]),
+                "payloads": list(h["payloads"]),
+                "offset": 0,
+                "part": 0,
+                "last": True,
+            })
+        return out
+
+    def adopt_handoff(self, rec: dict) -> None:
+        """Deliver a handed-off chain: graft the blocks (best-effort —
+        a failed adoption just cold-prefills) and, on the chain's last
+        part, enqueue the request past the draining front door (it was
+        accepted fleet-wide on the prefill side)."""
+        try:
+            self.engine.adopt_chain(
+                list(rec["request"].prompt), rec["payloads"],
+                offset=rec["offset"],
+            )
+        except ValueError:
+            self.engine.handoff_stats["adopt_fallbacks"] += 1
+        if rec["last"]:
+            self.engine.scheduler.submit(rec["request"], rec["arrival_s"])
 
     def start_drain(self) -> None:
         self.engine.drain()
@@ -249,6 +291,7 @@ class SocketReplica:
             net.FrameDecoder()
         )
         self.hello = dict(hello)
+        self.role = str(hello.get("role", "unified"))
         self.block_size = int(hello["block_size"])
         self.slots_n = int(hello["slots"])
         self.num_compiles = int(hello.get("num_compiles", 0))
@@ -274,6 +317,9 @@ class SocketReplica:
         #: Discarded admitted/result frames: unknown request id, epoch
         #: mismatch, or a duplicate of an already-recorded result.
         self.stale_frames = 0
+        #: Inbound binary KV frames (prefill→decode handoffs) awaiting
+        #: the router's dispatch pass.
+        self._kv_frames: list[net.KVFrame] = []
         #: Out-of-order heartbeats dropped by the seq check.
         self.stale_heartbeats = 0
         self.goodbye: dict | None = None
@@ -393,7 +439,13 @@ class SocketReplica:
             return rid, False
         return rid, True
 
-    def _handle(self, msg: dict) -> None:
+    def _handle(self, msg) -> None:
+        if isinstance(msg, net.KVFrame):
+            # Prefill worker shipping a finished chain: park it for the
+            # router's handoff-dispatch pass (this class is transport,
+            # placement policy lives router-side).
+            self._kv_frames.append(msg)
+            return
         kind = msg.get("type")
         if kind == "heartbeat":
             seq = int(msg.get("seq", -1))
@@ -447,6 +499,62 @@ class SocketReplica:
         elif kind == "goodbye":
             self.goodbye = msg
         # drained / poll_reply / hello acks need no folding here.
+
+    def take_handoffs(self) -> list[dict]:
+        """Normalize parked KV frames into the router's handoff-record
+        shape (same as the in-process :meth:`Replica.take_handoffs`)."""
+        frames, self._kv_frames = self._kv_frames, []
+        out = []
+        for f in frames:
+            m = f.meta
+            out.append({
+                "request": request_from_wire(m["request"]),
+                "arrival_s": float(m.get("arrival_s", 0.0)),
+                "epoch": int(m.get("epoch", 0)),
+                "digests": net.digests_from_wire(m.get("digests") or []),
+                "payloads": f.blocks(),
+                "offset": int(m.get("offset", 0)),
+                "part": int(m.get("part", 0)),
+                "last": bool(m.get("last", True)),
+            })
+        return out
+
+    def adopt_handoff(self, rec: dict) -> None:
+        """Forward a handoff record to this (decode) worker as an
+        ``adopt`` KV frame, sliced against the worker's last pushed
+        digest summary: leading blocks the summary says are already
+        resident here are dropped from the wire (the worker's own
+        adoption dedupes again, and a stale-summary overslice degrades
+        to a cold prefill worker-side — never to wrong tokens). The
+        ledger entry registers BEFORE the send so a peer that dies
+        mid-write is quarantined with this request in its queued set —
+        the standard retry path re-prefills it elsewhere."""
+        request = rec["request"]
+        rid = int(request.request_id)
+        if rid not in self._outstanding:
+            self._outstanding[rid] = (
+                request, rec["arrival_s"], int(rec["epoch"] or 0)
+            )
+            self._queued.add(rid)
+        payloads, offset = rec["payloads"], rec["offset"]
+        if payloads and self.block_size:
+            resident = self.match_digests(rec["digests"])
+            drop = min(len(payloads),
+                       max(0, resident // self.block_size - offset))
+            if drop:
+                payloads = payloads[drop:]
+                offset += drop
+        net.send_kv_frame(self.sock, {
+            "op": "adopt",
+            "request_id": rid,
+            "epoch": int(rec["epoch"] or 0),
+            "offset": offset,
+            "last": rec["last"],
+            "request": _request_to_wire(request),
+            "arrival_s": rec["arrival_s"],
+            "digests": net.digests_to_wire(rec["digests"]),
+            "sizes": [len(p) for p in payloads],
+        }, b"".join(payloads))
 
     def take_queued(self) -> list[tuple[Request, float]]:
         out = []
@@ -671,6 +779,35 @@ class ReplicaRouter:
                 )
                 self.replicas.append(Replica(index=i, engine=engine,
                                              telemetry=tel))
+        # Role topology (serving.role, docs/SERVING.md disaggregation):
+        # validated HERE, at fleet build, because only the router sees
+        # every member's role — each engine alone is a legal config.
+        self.roles = [
+            str(getattr(r, "role", "unified")) for r in self.replicas
+        ]
+        if ("decode" in self.roles
+                and not any(x in ("prefill", "unified")
+                            for x in self.roles)):
+            raise ValueError(
+                "decode-only fleet: every replica has serving.role="
+                "'decode', so no replica can run a prefill and nothing "
+                "is ever admitted — give at least one worker role="
+                "'prefill' (or 'unified')"
+            )
+        if ("prefill" in self.roles
+                and not any(x in ("decode", "unified")
+                            for x in self.roles)):
+            raise ValueError(
+                "prefill-only fleet: every replica has serving.role="
+                "'prefill', so handed-off chains have no decode replica "
+                "to land on — give at least one worker role='decode' "
+                "(or 'unified')"
+            )
+        #: Sticky multi-part handoff routing: (request_id, epoch) ->
+        #: decode replica index, cleared on the chain's last part.
+        self._handoff_routes: dict[tuple[int, int], int] = {}
+        self.handoffs = 0
+        self.handoff_parts = 0
         # Globally-unique request ids across replicas: each engine's
         # scheduler counts from 0, so the router must number requests
         # BEFORE dispatch or two replicas would mint colliding ids (and
@@ -710,6 +847,18 @@ class ReplicaRouter:
                 "ReplicaRouter has no live replicas (all draining or "
                 "quarantined) — cannot accept new requests"
             )
+        if any(getattr(r, "role", "unified") == "prefill" for r in live):
+            # Two-stage dispatch (disaggregated fleet): NEW requests land
+            # on the prefill stage only — decode replicas get their work
+            # by handoff. If every prefill/unified replica is dead, the
+            # filter lifts: a decode-role ENGINE prefills fine, and a
+            # degraded unified fleet beats a refused request.
+            front = [
+                r for r in live
+                if getattr(r, "role", "unified") != "decode"
+            ]
+            if front:
+                live = front
         if self.policy == "round_robin":
             r = live[self._rr % len(live)]
             self._rr += 1
@@ -859,6 +1008,7 @@ class ReplicaRouter:
         busy = False
         for r in self.replicas:
             busy = self.step_replica(r.index) or busy
+        busy = self.dispatch_handoffs() or busy
         self.check_heartbeats()
         if busy and self.io_wait_s:
             socks = [
@@ -871,6 +1021,140 @@ class ReplicaRouter:
                 # from under them.
                 select.select(socks, [], [], self.io_wait_s)
         return busy
+
+    # ------------------------------------------------------------------
+    # prefill→decode handoff routing (docs/SERVING.md disaggregation)
+    # ------------------------------------------------------------------
+
+    def dispatch_handoffs(self) -> bool:
+        """Collect every replica's pending handoffs (engine records
+        in-process, parked KV frames over sockets) and forward each to
+        a decode replica. Returns True when anything moved."""
+        moved = False
+        for src in self.replicas:
+            if src.quarantined:
+                # Frames a now-dead prefill worker pushed before dying
+                # are dropped on the floor: its quarantine already
+                # retried every unresolved request under a bumped
+                # epoch, so acting on them would double-deliver.
+                continue
+            take = getattr(src, "take_handoffs", None)
+            if take is None:
+                continue
+            for rec in take():
+                moved = True
+                self._route_handoff(src, rec)
+        return moved
+
+    def _pick_decode(self, now: float, rec: dict,
+                     exclude) -> "Replica | None":
+        """Decode-stage placement: among live decode replicas (falling
+        back to unified ones, then — last resort, mirroring
+        ``_retry_target`` — a live draining non-prefill replica), the
+        one whose trie already holds the longest run of the chain's
+        digests wins (the wire then ships only the novel tail);
+        least-loaded breaks ties and serves digest-cold chains."""
+        live = [r for r in self._live() if r is not exclude]
+        pool = [r for r in live
+                if getattr(r, "role", "unified") == "decode"]
+        if not pool:
+            pool = [r for r in live
+                    if getattr(r, "role", "unified") != "prefill"]
+        if not pool:
+            pool = [
+                r for r in self.replicas
+                if (r.draining and not r.quarantined and r is not exclude
+                    and getattr(r, "role", "unified") != "prefill")
+            ]
+        if not pool:
+            return None
+        loads = {}
+
+        def load(r):
+            if r.index not in loads:
+                g = r.load_gauges(now)
+                loads[r.index] = (
+                    g["pending"], g["active"], g["used_blocks"], r.index
+                )
+            return loads[r.index]
+
+        digests = rec.get("digests") or []
+        if digests:
+            matches = [(r.match_digests(digests), r) for r in pool]
+            best = max(m for m, _ in matches)
+            if best > 0:
+                choice = min(
+                    (r for m, r in matches if m == best), key=load
+                )
+                # Same starvation guard as admission (_pick): affinity
+                # concentrates warm chains, it must not wedge one decode
+                # replica while its siblings idle.
+                floor = min(load(r)[0] for r in pool)
+                if load(choice)[0] - floor <= choice.slots_n:
+                    return choice
+        return min(pool, key=load)
+
+    def _route_handoff(self, src, rec: dict) -> None:
+        """Forward one handoff record: on a chain's FIRST part, release
+        the source's ledger entry (epoch-checked — a handoff from a
+        superseded attempt is a stale frame), pick the decode target,
+        and move the route; later parts follow the sticky route. A
+        send that dies mid-forward quarantines the target, whose ledger
+        already holds the request — the standard retry path re-prefills
+        it under a bumped epoch."""
+        rid = int(rec["request"].request_id)
+        if rec["epoch"] is None:
+            rec["epoch"] = self.epochs.get(rid, 0)
+        key = (rid, int(rec["epoch"]))
+        target_index = self._handoff_routes.get(key)
+        if target_index is None:
+            outstanding = getattr(src, "_outstanding", None)
+            if outstanding is not None:
+                entry = outstanding.get(rid)
+                if entry is None or entry[2] != int(rec["epoch"]):
+                    # The request was already retried elsewhere (the
+                    # prefill worker is half-dead or slow): this chain
+                    # belongs to a superseded attempt.
+                    src.stale_frames += 1
+                    return
+                outstanding.pop(rid)
+                src._queued.discard(rid)
+            now = self.clock()
+            target = self._pick_decode(now, rec, src)
+            if target is None:
+                state = RequestState(
+                    request=rec["request"], arrival_s=rec["arrival_s"]
+                )
+                state.dropped = True
+                self.failed.append(state)
+                self._emit(serving_event(
+                    "request_failed", self.tick_count, request_id=rid,
+                    replica=src.index, reason="no_decode_replica",
+                ))
+                return
+            self._handoff_routes[key] = target.index
+            self.routes[rid] = target.index
+            self.handoffs += 1
+            self._emit(serving_event(
+                "request_handoff", self.tick_count, request_id=rid,
+                replica=src.index, target=target.index,
+                epoch=int(rec["epoch"]), blocks=len(rec["payloads"]),
+            ))
+        else:
+            target = self.replicas[target_index]
+            if target.quarantined:
+                # Mid-chain death: the quarantine already rerouted the
+                # request (it was in the target's queued ledger) — the
+                # remaining parts are moot.
+                self._handoff_routes.pop(key, None)
+                return
+        self.handoff_parts += 1
+        try:
+            target.adopt_handoff(rec)
+        except net.ProtocolError as exc:
+            self._quarantine(target, exc)
+        if rec["last"]:
+            self._handoff_routes.pop(key, None)
 
     def check_heartbeats(self, now: float | None = None) -> None:
         """Quarantine socket replicas whose last heartbeat is older than
@@ -1141,6 +1425,9 @@ class ReplicaRouter:
             "replicas": len(self.replicas),
             "router_policy": self.policy,
             "shed_policy": self.shed_policy,
+            "roles": list(self.roles),
+            "handoffs": self.handoffs,
+            "handoff_parts": self.handoff_parts,
             "shed": len(self.shed),
             "rerouted": self.rerouted,
             "retried": self.retried,

@@ -236,6 +236,7 @@ class KVBlockPool:
         self.promotes = 0
         self.adoptions = 0
         self.final_evictions = 0
+        self.chain_adoptions = 0  # blocks grafted from a wire handoff
 
     @property
     def free_blocks(self) -> int:
@@ -631,6 +632,114 @@ class KVBlockPool:
         elif self._spill_fn is not None:
             self._spill_fn([(b, nd.chain_hash)])
 
+    # -- cross-process chain handoff (docs/SERVING.md disaggregation) ------
+
+    def export_chain(self, tokens) -> tuple[list[bytes], list[int]]:
+        """The handoff sender's view of a prompt's cached chain: the
+        leading run of ``chain_digests(tokens)`` present in the trie,
+        as ``(digests, node_ids)``. Digests go in the KV-frame meta (the
+        router slices/dedupes against them), node ids tell the engine
+        which pool rows to capture. Read-only, like :meth:`match` —
+        the caller holds refcounts (or captures within the same step)
+        so the ids cannot be evicted under it."""
+        digests = chain_digests(tokens, self.block_size)
+        ids: list[int] = []
+        for d in digests:
+            b = self._by_hash.get(d)
+            if b is None:
+                break
+            ids.append(b)
+        return digests[:len(ids)], ids
+
+    def adopt_chain(self, tokens, blocks: list[int], *,
+                    start: int = 0) -> list[int]:
+        """Graft a TRANSFERRED chain into the trie at refcount 0 — the
+        receiving half of a prefill→decode handoff. ``blocks[j]`` is a
+        request-owned (``alloc``'d) device block into which the engine
+        has already scattered the KV of token block ``start + j``; the
+        leading ``start`` blocks were sliced off the wire because the
+        sender believed this pool already holds them, and must resolve
+        here (either tier) or the graft has no parent — a stale-summary
+        slice raises ``ValueError`` and the caller falls back to a cold
+        prefill (correctness never depends on adoption).
+
+        Races with local traffic resolve like :meth:`publish`: a
+        position that gained a DEVICE copy since the sender sliced keeps
+        the existing copy (ours is freed back); a HOST-tier hit adopts
+        our freshly-written block exactly like publish's adoption branch
+        (we hold real device KV for it — the transfer doubles as a free
+        promotion). Returns the node id now caching each adopted
+        position, parent-first."""
+        if not self.prefix_cache:
+            raise ValueError("adopt_chain with prefix_cache=False — the "
+                             "trie IS the handoff ledger")
+        bs = self.block_size
+        if (start + len(blocks)) * bs > len(tokens):
+            raise ValueError("adopt_chain: blocks cover more tokens than "
+                             "given")
+        self._tick += 1
+        parent_hash = _ROOT_HASH
+        parent_block: int | None = None
+        for k in range(start):
+            parent_hash = _block_hash(
+                parent_hash, tokens[k * bs:(k + 1) * bs]
+            )
+            existing = self._by_hash.get(parent_hash)
+            if existing is None:
+                raise ValueError(
+                    f"adopt_chain: leading block {k} absent — sliced "
+                    "against a stale digest summary"
+                )
+            parent_block = existing
+        out: list[int] = []
+        for j, b in enumerate(blocks):
+            k = start + j
+            parent_hash = _block_hash(
+                parent_hash, tokens[k * bs:(k + 1) * bs]
+            )
+            existing = self._by_hash.get(parent_hash)
+            if existing is not None and existing > 0:
+                # Local traffic cached this position since the sender
+                # sliced — the existing copy wins, ours goes back.
+                self.free([b])
+                nd = self._cached[existing]
+                nd.last_use = self._tick
+                parent_block = existing
+                out.append(existing)
+                continue
+            if b not in self._allocated:
+                raise ValueError(f"adopting unowned block {b}")
+            self._allocated.remove(b)
+            if existing is not None:
+                # Host-tier node: re-key it onto our block (publish's
+                # adoption branch) — the wire payload we scattered IS
+                # this block's KV, so the host copy is redundant.
+                nd = self._cached.pop(existing)
+                self._cached[b] = nd
+                self._by_hash[parent_hash] = b
+                if nd.parent is not None:
+                    p = self._cached[nd.parent]
+                    p.children.discard(existing)
+                    p.children.add(b)
+                for c in nd.children:
+                    self._cached[c].parent = b
+                nd.last_use = self._tick
+                self.adoptions += 1
+                if self._drop_fn is not None:
+                    self._drop_fn(parent_hash)
+            else:
+                nd = _PrefixNode(parent_hash, parent_block, 0, self._tick,
+                                 depth=k + 1)
+                self._cached[b] = nd
+                self._by_hash[parent_hash] = b
+                if parent_block is not None:
+                    self._cached[parent_block].children.add(b)
+            self.published_total += 1
+            self.chain_adoptions += 1
+            parent_block = b
+            out.append(b)
+        return out
+
     def flush_cache(self) -> int:
         """Drop every refcount-0 cache node in BOTH tiers (leaf-first,
         ``(last_use, id)`` order — no spilling: a flush is a teardown,
@@ -857,7 +966,7 @@ class Scheduler:
 
     def __init__(self, slots: int, pool: KVBlockPool, max_seq_len: int, *,
                  kv_bytes_per_token: int | None = None,
-                 kv_quant: str | None = None):
+                 kv_quant: str | None = None, role: str | None = None):
         if slots < 1:
             raise ValueError(f"serving.slots must be >= 1, got {slots}")
         self.slots: list[RequestState | None] = [None] * slots
@@ -869,9 +978,21 @@ class Scheduler:
         # in ~4x fewer bytes, so block counts alone mislead the router.
         self.kv_bytes_per_token = kv_bytes_per_token
         self.kv_quant = kv_quant
+        # Disaggregation phase role (None = omit from gauges(), the
+        # pre-role gauge shape). The engine keeps the two handoff
+        # counters current: queue depth (export records not yet shipped)
+        # and cumulative KV bytes moved over the wire, both directions.
+        self.role = role
+        self.handoff_queue_depth = 0
+        self.handoff_bytes_total = 0
         self.pending: deque[RequestState] = deque()
         self.finished: list[RequestState] = []
         self.dropped: list[RequestState] = []
+        # Prefill-role retirements: the lane is free and the prompt's
+        # blocks live on in the trie (refcount 0 — the handoff ledger),
+        # but the request is NOT finished serving work — no result is
+        # delivered from this engine; the decode side owns delivery.
+        self.handed_off: list[RequestState] = []
         self._ids = itertools.count()
         self.admitted_total = 0
         # Prefix-cache counters (stay 0 with the cache off): prompt tokens
@@ -1050,6 +1171,32 @@ class Scheduler:
     # -- retirement --------------------------------------------------------
 
     def complete(self, slot: int, now: float) -> RequestState:
+        state = self._retire(slot, now)
+        self.finished.append(state)
+        return state
+
+    def complete_handoff(self, slot: int, now: float, *,
+                         written: int | None = None) -> RequestState:
+        """Prefill-role retirement: identical block accounting to
+        :meth:`complete` — the prompt's full blocks end up published at
+        refcount 0, i.e. resident in the trie as the handoff ledger
+        entry — but the state lands in ``handed_off``, not ``finished``:
+        this engine never delivers a result for it (the decode replica
+        that adopts the chain does). ``written`` overrides the written-
+        token count for the completion-time publish: a decode-route
+        handoff never ran a forward at all, so its LAST prompt token's
+        KV is unwritten and the default no-generated-tokens rule
+        ("prefill wrote every prompt position") would publish a block
+        holding one garbage position. The engine must capture the
+        exported payload bytes in the SAME step, before another
+        admission's eviction pressure can reclaim the refcount-0
+        chain."""
+        state = self._retire(slot, now, written=written)
+        self.handed_off.append(state)
+        return state
+
+    def _retire(self, slot: int, now: float, *,
+                written: int | None = None) -> RequestState:
         state = self.slots[slot]
         if state is None:
             raise ValueError(f"slot {slot} is empty")
@@ -1068,7 +1215,8 @@ class Scheduler:
             # free what stayed private.
             seq = state.request.prompt + state.generated
             chain = state.cached_blocks + state.blocks
-            written = len(seq) - (1 if state.generated else 0)
+            if written is None:
+                written = len(seq) - (1 if state.generated else 0)
             n_full = min(written // self.pool.block_size, len(chain))
             now_published = (
                 self.pool.publish(seq[:n_full * self.pool.block_size],
@@ -1089,7 +1237,6 @@ class Scheduler:
             self.pool.free(state.blocks)
         state.blocks = []
         self.slots[slot] = None
-        self.finished.append(state)
         return state
 
     # -- introspection -----------------------------------------------------
@@ -1118,6 +1265,12 @@ class Scheduler:
             "used_blocks": self.pool.used_blocks,
             "block_high_water": self.pool.high_water,
         }
+        if self.role is not None:
+            out["role"] = self.role
+            out["handed_off"] = len(self.handed_off)
+            out["handoff_queue_depth"] = self.handoff_queue_depth
+            out["handoff_bytes_total"] = self.handoff_bytes_total
+            out["chain_adoptions"] = self.pool.chain_adoptions
         if self.pool.prefix_cache:
             out["prefix_cache"] = {
                 "hit_tokens": self.prefix_hit_tokens,
@@ -1172,6 +1325,13 @@ class Scheduler:
             g["kv_bytes_per_token"] = self.kv_bytes_per_token
         if self.kv_quant is not None:
             g["kv_quant"] = self.kv_quant
+        if self.role is not None:
+            # Phase-split visibility: which phase this engine serves and
+            # how much handoff work is queued/has moved — cli report and
+            # FLEET.json surface the split from heartbeats alone.
+            g["role"] = self.role
+            g["handoff_queue_depth"] = self.handoff_queue_depth
+            g["handoff_bytes_total"] = self.handoff_bytes_total
         if self.pool.prefix_cache:
             g["prefix_hit_rate"] = round(self.prefix_hit_rate(), 6)
             # Cache-pressure gauges: least-loaded and prefix-affinity

@@ -49,7 +49,7 @@ import time
 from ..supervisor import EXIT_FAULT, EXIT_PREEMPTED
 from ..telemetry import NULL_TELEMETRY
 from . import net
-from .router import request_from_wire, state_to_wire
+from .router import _request_to_wire, request_from_wire, state_to_wire
 
 #: Heartbeat digest-summary cap: enough for every realistic trie on the
 #: CPU sim; bounds the heartbeat frame regardless of pool size.
@@ -153,6 +153,7 @@ class ReplicaWorker:
                  shed_percentile: float = 50.0,
                  digest_limit: int = DIGEST_SUMMARY_LIMIT,
                  telemetry=NULL_TELEMETRY, step_dwell_s: float = 0.0,
+                 prefill_dwell_per_token_s: float = 0.0,
                  fault=None, exit_hook=None,
                  spill_store: str | None = None,
                  spill_checkpoint_every_s: float = 0.0):
@@ -166,6 +167,15 @@ class ReplicaWorker:
         self.digest_limit = int(digest_limit)
         self.telemetry = telemetry
         self.step_dwell_s = float(step_dwell_s)
+        # Prefill dwell: extra sleep per PREFILLED token this step (the
+        # trie's running miss-token counter is exactly "tokens this
+        # engine computed KV for"). On a real device prefill time grows
+        # with uncached prompt length while a decode step is roughly
+        # flat — this knob gives the CPU sim that latency structure, so
+        # the disagg bench's inter-token-latency delta measures real
+        # step composition (decode lanes stalling behind another
+        # request's prefill), not an assumed speedup.
+        self.prefill_dwell_per_token_s = float(prefill_dwell_per_token_s)
         self.exit_code: int | None = None
         self._exit_when_idle: int | None = None
         self._decoder = net.FrameDecoder()
@@ -209,12 +219,21 @@ class ReplicaWorker:
             # pump loop converts this into the drain-and-exit path.
             self._peer_gone = True
 
+    def _send_kv(self, meta: dict, body: bytes) -> None:
+        if self._peer_gone:
+            return
+        try:
+            net.send_kv_frame(self.conn, meta, body)
+        except (OSError, net.ProtocolError):
+            self._peer_gone = True
+
     def start(self) -> None:
         """Hello handshake + first heartbeat (the router blocks on the
         hello to learn block_size/slots before any dispatch)."""
         self._send({
             "type": "hello",
             "replica": self.index,
+            "role": self.engine.role,
             "block_size": self.engine.block_size,
             "slots": self.engine.slots_n,
             "num_compiles": self.engine.num_compiles,
@@ -275,9 +294,96 @@ class ReplicaWorker:
                             "epoch": epoch,
                             "state": state_to_wire(state)})
 
+    def _push_handoffs(self) -> None:
+        """Frame out every queued prefill→decode handoff (engine role
+        'prefill'): each becomes one or more binary KV frames to the
+        ROUTER — the worker never learns fleet membership; the router
+        picks the decode target by digest affinity and forwards. Chains
+        longer than ``serving.handoff_blocks_per_frame`` split into
+        in-order parts on the same socket; each part is independently
+        adoptable (its leading blocks are resident once the previous
+        part landed) and only the LAST part triggers the decode-side
+        submit, so no part ever nears the 16MB frame cap. A handed-off
+        request gets NO result frame from this worker — the KV frame
+        itself moves the router's ledger to the decode replica."""
+        for h in self.engine.take_handoffs():
+            req, state = h["request"], h["state"]
+            rid = int(req.request_id)
+            epoch = self._epochs.get(rid, 0)
+            payloads = h["payloads"]
+            per = max(1, int(getattr(
+                self.engine.cfg, "handoff_blocks_per_frame", 64
+            )))
+            parts = max(1, -(-len(payloads) // per))
+            digests_hex = net.digests_to_wire(h["digests"])
+            for i in range(parts):
+                chunk = payloads[i * per:(i + 1) * per]
+                self._send_kv({
+                    "op": "handoff",
+                    "request_id": rid,
+                    "epoch": epoch,
+                    "part": i,
+                    "parts": parts,
+                    "last": i == parts - 1,
+                    "offset": i * per,
+                    "request": _request_to_wire(req),
+                    "arrival_s": state.arrival_s,
+                    "digests": digests_hex,
+                    "sizes": [len(p) for p in chunk],
+                    "codec": {
+                        "kv_quant": self.engine.kv_quant,
+                        "block_bytes": self.engine.block_bytes,
+                        "block_size": self.engine.block_size,
+                    },
+                }, b"".join(chunk))
+
+    def _handle_kv(self, frame: net.KVFrame) -> None:
+        """An ``adopt`` KV frame from the router: scatter the shipped
+        blocks into the local pool/trie, and on the chain's LAST part
+        submit the request — it then admits as a (near-)full prefix
+        hit. Adoption failures (stale slice, layout mismatch, full
+        pool) degrade to a cold prefill: the submit still happens, so
+        correctness never depends on the transfer."""
+        meta = frame.meta
+        if meta.get("op") != "adopt":
+            self._send({
+                "type": "error",
+                "error": f"unexpected kv frame op {meta.get('op')!r}",
+            })
+            return
+        rid = int(meta["request_id"])
+        request = request_from_wire(meta["request"])
+        try:
+            self.engine.adopt_chain(
+                list(request.prompt), frame.blocks(),
+                offset=int(meta.get("offset", 0)),
+            )
+        except ValueError:
+            # Layout/overrun mismatch: the blocks are unusable here but
+            # the request is not — cold prefill covers it.
+            self.engine.handoff_stats["adopt_fallbacks"] += 1
+        if meta.get("last", True):
+            self._epochs[rid] = int(meta.get("epoch", 0))
+            try:
+                # scheduler-level submit, like a reroute: the fleet
+                # front door already accepted this request on the
+                # prefill side.
+                self.engine.scheduler.submit(
+                    request, float(meta.get("arrival_s", self.clock()))
+                )
+            except Exception as exc:  # noqa: BLE001 — report, don't die
+                self._send({
+                    "type": "submit_error",
+                    "request_id": rid,
+                    "error": f"{type(exc).__name__}: {exc}",
+                })
+
     # -- inbound ----------------------------------------------------------
 
-    def handle(self, msg: dict) -> None:
+    def handle(self, msg) -> None:
+        if isinstance(msg, net.KVFrame):
+            self._handle_kv(msg)
+            return
         op = msg.get("op")
         if op == "submit":
             request = request_from_wire(msg["request"])
@@ -430,11 +536,21 @@ class ReplicaWorker:
             busy = True
             self.handle(msg)
         if not self.engine.scheduler.idle:
+            miss0 = getattr(
+                self.engine.scheduler, "prefix_miss_tokens", 0
+            )
             busy = self.engine.step() or busy
             self._steps_done += 1
             self._sync_lifecycle()
-            if self.step_dwell_s:
-                self.sleep(self.step_dwell_s)
+            self._push_handoffs()
+            dwell = self.step_dwell_s
+            if self.prefill_dwell_per_token_s:
+                dwell += self.prefill_dwell_per_token_s * (
+                    getattr(self.engine.scheduler,
+                            "prefix_miss_tokens", 0) - miss0
+                )
+            if dwell:
+                self.sleep(dwell)
         self.heartbeat()
         self.checkpoint_spill()
         if (self._exit_when_idle is not None
@@ -444,6 +560,7 @@ class ReplicaWorker:
 
     def _finish(self, code: int) -> None:
         self._sync_lifecycle()
+        self._push_handoffs()
         self.checkpoint_spill(force=True)
         try:
             self._send({
@@ -600,6 +717,11 @@ def main(argv=None) -> int:
     p.add_argument("--dwell-s", type=float, default=0.0,
                    help="sleep this long after every engine step — the "
                    "CPU sim's device-latency stand-in (bench only)")
+    p.add_argument("--prefill-dwell-per-token-s", type=float, default=0.0,
+                   help="extra sleep per token this step PREFILLED "
+                   "(trie miss tokens) — models prefill cost growing "
+                   "with uncached prompt length while decode stays "
+                   "flat; the disagg bench's timebase (bench only)")
     p.add_argument("--spill-store", default=None,
                    help="spill-tier persistence file: loaded on boot if "
                    "it exists (the restart re-warm), written on the "
@@ -661,6 +783,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "event": "worker_ready",
         "replica": args.replica_index,
+        "role": engine.role,
         "host": args.host,
         "port": port,
         "pid": os.getpid(),
@@ -703,6 +826,7 @@ def main(argv=None) -> int:
         shed_percentile=scfg.shed_percentile,
         telemetry=tel,
         step_dwell_s=args.dwell_s,
+        prefill_dwell_per_token_s=args.prefill_dwell_per_token_s,
         fault=armed_fault(scfg, args.replica_index),
         spill_store=args.spill_store,
         spill_checkpoint_every_s=getattr(

@@ -966,3 +966,137 @@ def test_fleet_healing_legal_pairs_pass(kwargs, fleet):
         serving=ServingConfig(**kwargs),
     )
     check_serving_composition(cfg, fleet=fleet)  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# Disaggregation fence matrix (serving.role x prefill_replicas x fleet)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kwargs,fleet,err,match", [
+    # role domain: typos name the knob and the legal set
+    (dict(role="draft"), 0, ValueError, "serving.role"),
+    (dict(role="Prefill"), 0, ValueError, "serving.role"),
+    # any non-unified role needs the trie — it IS the handoff ledger
+    (dict(role="prefill"), 0, ValueError,
+     "role='prefill' x prefix_cache=False"),
+    (dict(role="decode"), 0, ValueError,
+     "role='decode' x prefix_cache=False"),
+    # prefill never decodes, so decode-side speculation on a prefill
+    # replica is dead config: fail, don't silently ignore
+    (dict(role="prefill", prefix_cache=True, speculation="ngram:3"), 0,
+     ValueError, "speculation"),
+    # split topology knobs: negative count; split without a fleet; split
+    # that leaves no decode replica; split without the trie
+    (dict(prefill_replicas=-1), 0, ValueError, "prefill_replicas"),
+    (dict(prefill_replicas=1, prefix_cache=True), 0, ValueError,
+     "in-process"),
+    (dict(prefill_replicas=4, prefix_cache=True), 4, ValueError,
+     "at least one decode replica"),
+    (dict(prefill_replicas=5, prefix_cache=True), 4, ValueError,
+     "at least one decode replica"),
+    (dict(prefill_replicas=1), 4, ValueError, "prefix_cache=true"),
+    # handoff chunking floor names the knob
+    (dict(handoff_blocks_per_frame=0), 0, ValueError,
+     "handoff_blocks_per_frame"),
+])
+def test_disagg_fence_matrix(kwargs, fleet, err, match):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(
+        model=ModelConfig(name="gpt2"),
+        serving=ServingConfig(**kwargs),
+    )
+    with pytest.raises(err, match=match):
+        check_serving_composition(cfg, fleet=fleet)
+
+
+@pytest.mark.parametrize("kwargs,fleet", [
+    # single-role engines are legal alone (tests build them directly);
+    # only the ROUTER can see a whole-fleet topology hole
+    (dict(role="prefill", prefix_cache=True), 0),
+    (dict(role="decode", prefix_cache=True), 0),
+    # decode replicas may keep speculation — drafting is decode-side work
+    (dict(role="decode", prefix_cache=True, speculation="ngram:3"), 0),
+    # the bench topology: 1 prefill + 3 decode over affinity routing
+    (dict(prefill_replicas=1, prefix_cache=True, suffix_buckets=(8,),
+          router_policy="prefix_affinity"), 4),
+    # split x the full serving stack: quant pool + host spill tier
+    (dict(prefill_replicas=2, prefix_cache=True, kv_quant="int8",
+          spill_blocks=16), 4),
+    # tighter chunking is a tuning knob, not a fence
+    (dict(prefill_replicas=1, prefix_cache=True,
+          handoff_blocks_per_frame=1), 2),
+])
+def test_disagg_legal_compositions_pass(kwargs, fleet):
+    from distributeddeeplearning_tpu.config import (
+        Config, ModelConfig, ServingConfig,
+    )
+    from distributeddeeplearning_tpu.serving import check_serving_composition
+
+    cfg = Config(
+        model=ModelConfig(name="gpt2"),
+        serving=ServingConfig(**kwargs),
+    )
+    check_serving_composition(cfg, fleet=fleet)  # must not raise
+
+
+def test_role_split_engine_rejects_static_batching_by_name():
+    # The static baseline forms whole batches in ONE engine: there is no
+    # phase boundary to split. Fenced in the engine ctor because tests
+    # build engines directly from a ServingConfig.
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu import models
+    from distributeddeeplearning_tpu.config import ServingConfig
+    from distributeddeeplearning_tpu.serving import ServingEngine
+
+    model = models.get_model("gpt2", size="tiny", vocab_size=97, max_len=64)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.int32)
+    )["params"]
+    cfg = ServingConfig(slots=2, block_size=4, hbm_budget_mb=8,
+                        max_seq_len=32, prompt_buckets=(8,),
+                        prefix_cache=True, role="prefill")
+    with pytest.raises(NotImplementedError, match="static_batching"):
+        ServingEngine(model, params, cfg, static_batching=True)
+
+
+@pytest.mark.parametrize("roles,match", [
+    (["decode", "decode"], "decode-only fleet"),
+    (["prefill", "prefill"], "prefill-only fleet"),
+])
+def test_router_rejects_single_phase_fleet_topology(roles, match):
+    # Each engine's role is a legal config alone; only the router sees
+    # every member, so the whole-fleet topology hole is fenced at fleet
+    # build — by name, before any request is admitted.
+    import dataclasses
+    import socket
+
+    from distributeddeeplearning_tpu.config import ServingConfig
+    from distributeddeeplearning_tpu.serving import ReplicaRouter, SocketReplica
+
+    cfg = ServingConfig(slots=2, block_size=4, hbm_budget_mb=8,
+                        max_seq_len=32, prompt_buckets=(8,),
+                        prefix_cache=True, suffix_buckets=(4,))
+    socks = []
+    transports = []
+    try:
+        for i, role in enumerate(roles):
+            a, b = socket.socketpair()
+            socks += [a, b]
+            hello = {"type": "hello", "replica": i, "role": role,
+                     "block_size": 4, "slots": 2, "gauges": {}}
+            transports.append(
+                SocketReplica(i, a, hello, clock=lambda: 0.0)
+            )
+        with pytest.raises(ValueError, match=match):
+            ReplicaRouter(None, None, dataclasses.replace(cfg),
+                          clock=lambda: 0.0, transports=transports)
+    finally:
+        for s in socks:
+            s.close()

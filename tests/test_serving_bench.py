@@ -194,15 +194,23 @@ def _check_kv_shape(rec):
     kv = rec["kv_hierarchy"]
     assert kv["device_blocks"] > 0
     assert kv["spill_blocks"] > kv["tight_spill_blocks"] > 0
-    off, fp, tight, int8, adv = kv["rows"]
+    off, fp, tight, int8, adv, sync = kv["rows"]
     comp = kv["comparison"]
     # The baseline row runs the SAME constrained pool with no spill tier.
     assert off["prefix"]["spill_budget"] == 0
     assert "spill_bytes" not in off["prefix"]
+    # The async-promote A/B: every row stages the promote upload at
+    # admission-match time except the sync control, which is the fp
+    # spill row re-run with the upload back on the dispatch path.
+    assert sync["promote_async"] is False
+    assert sync["prefix"]["spill_codec"] == "fp"
+    for row in (off, fp, tight, int8, adv):
+        assert row["promote_async"] is True
     for row, budget in ((fp, kv["spill_blocks"]),
                         (tight, kv["tight_spill_blocks"]),
                         (int8, kv["spill_blocks"]),
-                        (adv, kv["spill_blocks"])):
+                        (adv, kv["spill_blocks"]),
+                        (sync, kv["spill_blocks"])):
         p = row["prefix"]
         assert row["constrained_blocks"] == kv["device_blocks"]
         assert p["spill_budget"] == budget
@@ -321,6 +329,9 @@ def test_serve_bench_smoke(tmp_path):
     assert rec["router"]["replicas_swept"] == [1, 2]
     assert all(r["requests"] == 8 for r in rec["router"]["rows"])
     assert rec["fleet"] is None
+    # no fleet machinery -> no disagg A/B either (it rides the same
+    # worker-process harness)
+    assert rec["disagg"] is None
 
 
 @pytest.mark.slow
@@ -333,7 +344,7 @@ def test_serve_bench_fleet_smoke(tmp_path):
                      DDL_SERVE_SEED="0", DDL_SERVE_REPLICAS="1",
                      DDL_SERVE_LOADS="10", DDL_SERVE_ROUTER_N="8",
                      DDL_SERVE_FLEET="1,2", DDL_SERVE_FLEET_N="6",
-                     DDL_SERVE_DWELL="0.01")
+                     DDL_SERVE_DWELL="0.01", DDL_SERVE_DISAGG="")
     flt = rec["fleet"]
     assert flt["workers_swept"] == [1, 2]
     assert "wall clock" in flt["timebase"]
@@ -353,6 +364,47 @@ def test_serve_bench_fleet_smoke(tmp_path):
     assert comp["shed_accounting_exact"] is True
     # The 2-worker row carries the merged-telemetry check.
     assert comp["fleet_merge_processes"] == [0, 1]
+
+
+@pytest.mark.slow
+def test_serve_bench_disagg_smoke(tmp_path):
+    # A shrunken disagg A/B through the real tool path: 1 prefill + 1
+    # decode worker vs 2 unified, real KV-frame handoffs on real
+    # sockets. The p99 ITL RATIO is not asserted (2 workers, 6 requests
+    # on a shared CI host: noise) — roles, exact greedy parity vs the
+    # unified oracle, handoff coverage, compile pins, accounting, and
+    # clean exits are.
+    rec = _run_bench(tmp_path, DDL_SERVE_N="6", DDL_SERVE_RATE="100",
+                     DDL_SERVE_SEED="0", DDL_SERVE_REPLICAS="1",
+                     DDL_SERVE_LOADS="10", DDL_SERVE_ROUTER_N="8",
+                     DDL_SERVE_FLEET="1", DDL_SERVE_FLEET_N="6",
+                     DDL_SERVE_DWELL="0.01",
+                     DDL_SERVE_DISAGG_WORKERS="2", DDL_SERVE_DISAGG_N="6",
+                     DDL_SERVE_PREFILL_DWELL="0.002")
+    d = rec["disagg"]
+    assert d["workers"] == 2
+    assert d["roles_split"] == ["prefill", "decode"]
+    uni, split = d["rows"]
+    assert uni["roles"] == ["unified", "unified"]
+    assert split["roles"] == ["prefill", "decode"]
+    assert uni["handoffs"] == 0
+    assert split["handoffs"] == 6
+    assert split["handoff_parts"] >= 6
+    for row in (uni, split):
+        assert row["tokens_match_oracle"] is True
+        assert row["worker_exit_codes"] == [0] * 2
+        assert (row["compiles_after_run"] == row["compiles_at_ready"]
+                == [row["compile_pin_per_worker"]] * 2)
+        assert (row["served"] + row["shed"] + row["dropped_in_queue"]
+                == row["requests"] == 6)
+        assert row["decode_itl_s"]["p50"] is not None
+    comp = d["comparison"]
+    assert comp["tokens_match_oracle"] is True
+    assert comp["accounting_exact"] is True
+    assert comp["handoffs_cover_trace"] is True
+    assert comp["handoffs_unified_zero"] is True
+    assert comp["workers_exit_zero"] is True
+    assert comp["zero_recompiles_per_worker"] is True
 
 
 @pytest.mark.slow
@@ -431,3 +483,22 @@ def test_bench_serving_artifact():
     assert qc["block_capacity_ratio_int8"] >= 2.0
     assert qc["tokens_match_fp_shared"] is True
     assert qc["spill_hit_token_recovery_int8"] >= 2.0
+    # Async spill-promote pins: staged off the dispatch path (the stage
+    # histogram only exists when the copy actually ran async) and p50
+    # promote wait within the regression bar of the sync A/B row.
+    assert kvc["async_promote_staged_off_dispatch_path"] is True
+    assert kvc["async_promote_p50_no_worse"] is True
+    assert kvc["tokens_match_spill_off_sync_promote"] is True
+    # Disaggregation headline (the acceptance bar): 1 prefill + 3 decode
+    # vs 4 unified on the long-prompt burst — decode-phase p99 ITL at
+    # most 0.6x, exact greedy parity, per-role compile pins unchanged,
+    # full handoff coverage, exact accounting.
+    dc = rec["disagg"]["comparison"]
+    assert dc["decode_p99_itl_ratio"] <= 0.6
+    assert dc["tokens_match_oracle"] is True
+    assert dc["zero_recompiles_per_worker"] is True
+    assert dc["accounting_exact"] is True
+    assert dc["handoffs_cover_trace"] is True
+    assert dc["handoffs_unified_zero"] is True
+    assert dc["workers_exit_zero"] is True
+    assert rec["disagg"]["roles_split"] == ["prefill"] + ["decode"] * 3

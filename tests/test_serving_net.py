@@ -104,6 +104,126 @@ def test_round_trip_fuzz_random_sizes_and_splits():
     assert dec.buffered == 0
 
 
+# ---------------------------------------------------------------------------
+# Binary KV frames: the prefill→decode handoff payload shares the stream
+# with JSON frames and must survive the same arbitrary re-chunking
+# ---------------------------------------------------------------------------
+
+
+def _kv(meta, blocks):
+    from distributeddeeplearning_tpu.serving.net import encode_kv_frame
+
+    body = b"".join(blocks)
+    return encode_kv_frame({**meta, "sizes": [len(b) for b in blocks]}, body)
+
+
+def test_kv_frame_round_trip():
+    from distributeddeeplearning_tpu.serving.net import KVFrame
+
+    blocks = [b"\x01" * 33, b"", b"\xff\x00kv-ish\x00" * 5]
+    meta = {"op": "kv_handoff", "request_id": 9, "part": 0, "last": True}
+    (out,) = FrameDecoder().feed(_kv(meta, blocks))
+    assert isinstance(out, KVFrame)
+    assert out.meta["request_id"] == 9 and out.meta["last"] is True
+    assert out.blocks() == blocks
+
+
+def test_mixed_json_and_kv_frames_rechunked_fuzz():
+    # A real handoff stream interleaves JSON control frames (submit,
+    # heartbeat, kv_adopted acks) with binary KV parts. Concatenate a
+    # seeded mix, replay it at random split boundaries, and require every
+    # frame back in order with its kind intact — including KV bodies that
+    # contain 0x00, fake length words, and KV_MAGIC itself.
+    from distributeddeeplearning_tpu.serving.net import KV_MAGIC, KVFrame
+
+    rng = random.Random(0xD15A66)
+    objs, wire = [], b""
+    for i in range(30):
+        if rng.random() < 0.5:
+            o = {"i": i, "type": rng.choice(["heartbeat", "kv_adopted"]),
+                 "pad": "j" * rng.randrange(200)}
+            objs.append(o)
+            wire += encode_frame(o)
+        else:
+            blocks = [bytes(rng.randrange(256) for _ in range(
+                rng.choice([0, 1, 64, 300]))) for _ in range(rng.randrange(4))]
+            blocks.append(KV_MAGIC + (1 << 30).to_bytes(4, "big"))
+            objs.append(("kv", i, blocks))
+            wire += _kv({"op": "kv_handoff", "i": i}, blocks)
+    dec = FrameDecoder()
+    got, pos = [], 0
+    while pos < len(wire):
+        step = rng.randrange(1, 500)
+        got.extend(dec.feed(wire[pos:pos + step]))
+        pos += step
+    assert dec.buffered == 0
+    assert len(got) == len(objs)
+    for out, ref in zip(got, objs):
+        if isinstance(ref, tuple):
+            assert isinstance(out, KVFrame)
+            assert out.meta["i"] == ref[1]
+            assert out.blocks() == ref[2]
+        else:
+            assert out == ref
+
+
+def test_kv_frame_oversized_rejected_by_name_on_encode():
+    from distributeddeeplearning_tpu.serving.net import encode_kv_frame
+
+    with pytest.raises(ProtocolError, match="max_bytes"):
+        encode_kv_frame({"sizes": [4096]}, b"\x00" * 4096, max_bytes=512)
+
+
+def test_kv_frame_sizes_must_cover_body_on_encode():
+    from distributeddeeplearning_tpu.serving.net import encode_kv_frame
+
+    # Encode enforces the same invariant decode checks — a torn handoff
+    # can never be framed as valid.
+    with pytest.raises(ProtocolError, match="do not cover body"):
+        encode_kv_frame({"sizes": [8, 8]}, b"\x00" * 15)
+    with pytest.raises(ProtocolError, match="do not cover body"):
+        encode_kv_frame({"sizes": None}, b"")
+
+
+def test_kv_frame_truncated_mid_block_is_protocol_error():
+    import json as _json
+
+    from distributeddeeplearning_tpu.serving.net import KV_MAGIC
+
+    # Hand-build a KV payload whose declared sizes overrun the actual
+    # body — the shape a sender that died mid-chain would leave behind if
+    # the length word still closed. Must be a typed error, not a short
+    # slice silently adopted as a valid block.
+    meta = _json.dumps({"sizes": [16, 16]}).encode()
+    payload = KV_MAGIC + len(meta).to_bytes(4, "big") + meta + b"\x01" * 20
+    wire = len(payload).to_bytes(4, "big") + payload
+    with pytest.raises(ProtocolError, match="truncated mid-block"):
+        FrameDecoder().feed(wire)
+
+
+def test_kv_frame_malformed_meta_is_protocol_error():
+    from distributeddeeplearning_tpu.serving.net import KV_MAGIC
+
+    # meta_len overrunning the payload, garbage meta JSON, and meta
+    # without integer sizes each get their own typed rejection.
+    bad_len = KV_MAGIC + (999).to_bytes(4, "big") + b"{}"
+    wire = len(bad_len).to_bytes(4, "big") + bad_len
+    with pytest.raises(ProtocolError, match="overruns"):
+        FrameDecoder().feed(wire)
+
+    bad_json = KV_MAGIC + (4).to_bytes(4, "big") + b"{nop"
+    wire = len(bad_json).to_bytes(4, "big") + bad_json
+    with pytest.raises(ProtocolError, match="malformed kv frame meta"):
+        FrameDecoder().feed(wire)
+
+    import json as _json
+    meta = _json.dumps({"sizes": [4, "x"]}).encode()
+    bad_sizes = KV_MAGIC + len(meta).to_bytes(4, "big") + meta + b"\x00" * 4
+    wire = len(bad_sizes).to_bytes(4, "big") + bad_sizes
+    with pytest.raises(ProtocolError, match="missing block sizes"):
+        FrameDecoder().feed(wire)
+
+
 def test_digest_hex_codec_round_trip():
     digests = [bytes(range(16)), b"\x00" * 16, b"\xff" * 16]
     assert digests_from_wire(digests_to_wire(digests)) == digests

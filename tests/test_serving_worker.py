@@ -437,6 +437,28 @@ def test_fleet_plan_is_pure_and_stamps_process_index():
                     "NUM_PROCESSES": "8", "PROCESS_ID": "3"}  # pure
 
 
+def test_fleet_plan_roles_pin_per_index_and_scrub_split_knob():
+    # serving.prefill_replicas=K splits the fleet: the parent maps it to
+    # per-index role overrides. The role override is TRAILING (wins over
+    # any user-supplied serving.role) and prefill_replicas is scrubbed to
+    # 0 — a worker validates its config with fleet=1, where a live split
+    # knob would trip the prefill_replicas < fleet fence. Per-index plans
+    # also mean a supervisor respawn re-runs plan[i] and the restarted
+    # worker rejoins with its predecessor's role.
+    plan = _fleet_plan("cfg.py", ["serving.role=unified"], 4,
+                       roles=["prefill", "decode", "decode", "decode"])
+    for i, (cmd, _) in enumerate(plan):
+        overrides = [cmd[j + 1] for j, a in enumerate(cmd)
+                     if a == "--override"]
+        role = "prefill" if i == 0 else "decode"
+        assert overrides[-2:] == [f"serving.role={role}",
+                                  "serving.prefill_replicas=0"]
+        assert overrides[0] == "serving.role=unified"  # user's, outranked
+    # No roles -> no role overrides injected at all.
+    plan_u = _fleet_plan("cfg.py", [], 2)
+    assert all("--override" not in cmd for cmd, _ in plan_u)
+
+
 def test_read_worker_ready_skips_noise_and_errors_on_eof():
     ready = {"event": "worker_ready", "host": "127.0.0.1", "port": 41234}
     noise = []

@@ -117,6 +117,16 @@ def _headline(rec: dict) -> dict:
                   "shed_accounting_exact"):
             if k in flt["comparison"]:
                 out["fleet_" + k] = flt["comparison"][k]
+    # Serving disagg block: the role-split headline — decode-phase p99
+    # inter-token latency, 1-prefill/(N-1)-decode over N unified, on the
+    # long-prompt burst, with oracle parity and every request crossing
+    # the split exactly once.
+    dg = rec.get("disagg")
+    if isinstance(dg, dict) and isinstance(dg.get("comparison"), dict):
+        for k in ("decode_p99_itl_ratio", "tokens_match_oracle",
+                  "handoffs_cover_trace", "accounting_exact"):
+            if k in dg["comparison"]:
+                out["disagg_" + k] = dg["comparison"][k]
     # Serving prefix-cache block: the KV-reuse headline — prefill tokens
     # removed by the trie on the shared-prefix trace, the warm TTFT win,
     # and the honest ~0 hit rate on the adversarial control.
@@ -294,6 +304,20 @@ def check() -> int:
           fcomp.get("zero_recompiles_per_worker") is True)
     claim("fleet shed_accounting_exact",
           fcomp.get("shed_accounting_exact") is True)
+    # The disagg block (role-split serving on the long-prompt burst):
+    # the decode-ITL headline, oracle parity on both topologies, and
+    # conservation across the handoff.
+    dcomp = (serving.get("disagg") or {}).get("comparison", {})
+    claim("disagg block present", bool(dcomp))
+    claim("disagg decode_p99_itl_ratio <= 0.6",
+          dcomp.get("decode_p99_itl_ratio") is not None
+          and dcomp["decode_p99_itl_ratio"] <= 0.6)
+    claim("disagg tokens_match_oracle",
+          dcomp.get("tokens_match_oracle") is True)
+    claim("disagg accounting_exact",
+          dcomp.get("accounting_exact") is True)
+    claim("disagg handoffs_cover_trace",
+          dcomp.get("handoffs_cover_trace") is True)
     # The prefix-cache block (shared-prefix KV reuse): the headline
     # reduction, parity, and the honest adversarial control.
     pcomp = serving.get("prefix_cache", {}).get("comparison", {})
@@ -365,6 +389,12 @@ def check() -> int:
         claim("trajectory carries fleet_tokens_match_oracle",
               head.get("fleet_tokens_match_oracle")
               == fcomp.get("tokens_match_oracle"))
+        claim("trajectory carries disagg_decode_p99_itl_ratio",
+              head.get("disagg_decode_p99_itl_ratio")
+              == dcomp.get("decode_p99_itl_ratio"))
+        claim("trajectory carries disagg_tokens_match_oracle",
+              head.get("disagg_tokens_match_oracle")
+              == dcomp.get("tokens_match_oracle"))
         claim("trajectory carries prefix_prefill_token_reduction_shared",
               head.get("prefix_prefill_token_reduction_shared")
               == pcomp.get("prefill_token_reduction_shared"))

@@ -1,0 +1,75 @@
+"""One-shot committed-artifact gate: every ``--check`` validator, one exit.
+
+The repo accumulates committed JSON artifacts (BENCH_SERVING.json,
+SERVE_CHAOS_STATUS.json, BENCH_TRAJECTORY.json, TELEMETRY_STATUS.json /
+FLEET.json) and each producing tool carries a ``--check`` mode that
+re-validates its own artifact's pinned claims without re-running any
+engine. Those validators only gate CI when someone remembers to run
+them; this tool runs ALL of them in one shot so a single invocation —
+and the tier-1 test that wraps it — answers "are every committed
+artifact's claims still true against the current validators?".
+
+Each validator runs as a subprocess (exactly what CI and a human would
+run), its verdict is printed one line per tool, and the exit code is
+non-zero if ANY failed. A validator whose artifact is absent fails —
+the committed set is part of the contract, not optional.
+
+Usage::
+
+    python tools/check_artifacts.py           # run every --check
+    python tools/check_artifacts.py --list    # print the roster only
+"""
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Every committed-artifact validator in the repo. Add new tools here
+# when they grow a --check mode — the tier-1 wrapper test pins this
+# roster against the tools directory so a forgotten entry fails loudly.
+CHECKS = (
+    "tools/serve_bench.py",       # BENCH_SERVING.json pinned claims
+    "tools/serve_chaos.py",       # SERVE_CHAOS_STATUS.json healing runs
+    "tools/bench_report.py",      # BENCH_TRAJECTORY.json index + serving
+    "tools/telemetry_report.py",  # TELEMETRY_STATUS.json / FLEET.json
+)
+
+
+def run_checks(checks=CHECKS, *, echo=print) -> list[str]:
+    """Run every validator; returns the failing tool paths (empty = all
+    green). Output is one verdict line per tool plus the failing tools'
+    own output (their failure lists name the exact broken claims)."""
+    failures = []
+    for rel in checks:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_DIR, rel), "--check"],
+            capture_output=True, text=True, cwd=_DIR,
+        )
+        if proc.returncode == 0:
+            echo(f"{rel} --check: ok")
+        else:
+            failures.append(rel)
+            echo(f"{rel} --check: FAILED (rc={proc.returncode})")
+            for line in (proc.stdout + proc.stderr).strip().splitlines():
+                echo(f"  | {line}")
+    return failures
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--list" in argv:
+        for rel in CHECKS:
+            print(rel)
+        return 0
+    failures = run_checks()
+    if failures:
+        print(f"{len(failures)}/{len(CHECKS)} validator(s) failed")
+        return 1
+    print(f"all {len(CHECKS)} artifact validators green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
